@@ -1,0 +1,19 @@
+"""nds-tpu: a TPU-native decision-support (TPC-DS/NDS) benchmark framework.
+
+Capability parity with spark-rapids-benchmarks (NDS v2.0) — data generation,
+transcode, query-stream generation, power/throughput runs, data maintenance,
+validation, composite metric — with the Spark+CUDA execution engine replaced
+by a JAX/XLA/Pallas columnar SQL engine running SPMD over a TPU mesh, and the
+reference's native Java/C layer replaced by a C++ data generator.
+
+Subpackages:
+  schema    — 25 source + 12 maintenance table schemas (decimal/double switch)
+  datagen   — seeded, chunk-parallel C++ data generator + driver CLI
+  io        — CSV→Parquet transcode, columnar loader, ACID table layer
+  engine    — SQL → logical plan → optimizer → JAX columnar execution
+  parallel  — device mesh, shard_map distributed operators (ICI collectives)
+  queries   — query templates + reproducible stream generation
+  harness   — power/throughput/maintenance/validate/bench CLIs + reports
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""Schema registry parity tests (vs reference nds_schema.py:49-716)."""
+
+from ndstpu import schema
+
+
+def test_source_table_count():
+    s = schema.get_schemas(use_decimal=True)
+    assert len(s) == 25
+
+
+def test_maintenance_table_count():
+    s = schema.get_maintenance_schemas(use_decimal=True)
+    assert len(s) == 12
+
+
+def test_column_counts():
+    s = schema.get_schemas()
+    expected = {
+        "customer_address": 13, "customer_demographics": 9, "date_dim": 28,
+        "warehouse": 14, "ship_mode": 6, "time_dim": 10, "reason": 3,
+        "income_band": 3, "item": 22, "store": 29, "call_center": 31,
+        "customer": 18, "web_site": 26, "store_returns": 20,
+        "household_demographics": 5, "web_page": 14, "promotion": 19,
+        "catalog_page": 9, "inventory": 4, "catalog_returns": 27,
+        "web_returns": 24, "web_sales": 34, "catalog_sales": 34,
+        "store_sales": 23, "dbgen_version": 4,
+    }
+    for t, n in expected.items():
+        assert len(s[t]) == n, t
+
+
+def test_decimal_switch():
+    dec = schema.get_schemas(use_decimal=True)
+    flt = schema.get_schemas(use_decimal=False)
+    c = dec["store_sales"].column("ss_net_paid")
+    assert c.dtype.kind == "decimal" and (c.dtype.precision, c.dtype.scale) == (7, 2)
+    c2 = flt["store_sales"].column("ss_net_paid")
+    assert c2.dtype.kind == "float64"
+
+
+def test_identifier_width_policy():
+    s = schema.get_schemas()
+    # ticket numbers are 64-bit (reference rationale nds_schema.py:328-331)
+    assert s["store_sales"].column("ss_ticket_number").dtype.kind == "int64"
+    assert s["store_returns"].column("sr_ticket_number").dtype.kind == "int64"
+    # plain surrogate keys are 32-bit
+    assert s["store_sales"].column("ss_item_sk").dtype.kind == "int32"
+    assert s["customer"].column("c_customer_sk").dtype.kind == "int32"
+
+
+def test_nullability():
+    s = schema.get_schemas()
+    assert not s["store_sales"].column("ss_item_sk").nullable
+    assert s["store_sales"].column("ss_sold_date_sk").nullable
+    assert not s["date_dim"].column("d_date_sk").nullable
+
+
+def test_special_decimals():
+    s = schema.get_schemas()
+    assert s["promotion"].column("p_cost").dtype.precision == 15
+    assert s["customer_address"].column("ca_gmt_offset").dtype.precision == 5
+    assert s["store"].column("s_tax_precentage").dtype.precision == 5
+
+
+def test_partitioning_map():
+    assert len(schema.TABLE_PARTITIONING) == 7
+    assert schema.TABLE_PARTITIONING["store_sales"] == "ss_sold_date_sk"
+    assert schema.TABLE_PARTITIONING["inventory"] == "inv_date_sk"
+
+
+def test_maintenance_delete_tables():
+    s = schema.get_maintenance_schemas()
+    for t in ("delete", "inventory_delete"):
+        assert s[t].column_names == ["date1", "date2"]
