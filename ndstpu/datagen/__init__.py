@@ -1,0 +1,8 @@
+"""Seeded, chunk-parallel data generation (native C++ engine + Python driver).
+
+The native tool `ndsgen.cpp` replaces the reference's tpcds-gen/dsdgen layer
+(/root/reference/nds/tpcds-gen/, nds_gen_data.py) with a from-scratch,
+counter-based-RNG generator whose output is byte-identical under any
+`-parallel/-child` chunking.
+"""
+

@@ -1,0 +1,149 @@
+"""Data-generation driver CLI.
+
+Mirrors the reference driver's interface and behaviors
+(/root/reference/nds/nds_gen_data.py): local multiprocess fan-out of the
+native generator, per-table subdirectory layout, incremental `--range`
+generation merged from a temporary directory, `--update` refresh sets with
+separate placement of the delete-date tables, and an overwrite guard.
+
+The reference's `hdfs` mode (Hadoop MapReduce fan-out, GenTable.java) maps
+here to `dist` mode: the same child-chunk fan-out executed on this host for
+the host's slice of children — on a multi-host TPU pod each host runs the
+driver with its own `--range`, no cluster scheduler needed (chunk content is
+position-deterministic so any assignment of children to hosts is valid).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+
+from ndstpu import schema
+from ndstpu.check import (
+    check_build,
+    get_abs_path,
+    get_dir_size,
+    parallel_value_type,
+    valid_range,
+)
+
+SOURCE_TABLE_NAMES = schema.SOURCE_TABLE_NAMES
+MAINTENANCE_TABLE_NAMES = schema.MAINTENANCE_TABLE_NAMES
+
+
+def _fanout(args, range_start: int, range_end: int, data_dir: str,
+            tool: str) -> None:
+    """Run one `ndsgen` process per child chunk, concurrently."""
+    procs = []
+    for child in range(range_start, range_end + 1):
+        cmd = [
+            str(tool),
+            "-scale", str(args.scale),
+            "-dir", data_dir,
+            "-parallel", str(args.parallel),
+            "-child", str(child),
+        ]
+        if args.update:
+            cmd += ["-update", str(args.update)]
+        if args.seed is not None:
+            cmd += ["-seed", str(args.seed)]
+        procs.append(subprocess.Popen(cmd))
+    for p in procs:
+        p.wait()
+        if p.returncode != 0:
+            raise RuntimeError(f"ndsgen failed with return code {p.returncode}")
+
+
+def _move_into_table_dirs(data_dir: str, range_start: int, range_end: int,
+                          parallel: int, update: int | None) -> None:
+    """Move `{table}_{child}_{parallel}.dat` chunks into per-table folders
+    (reference: nds_gen_data.py:229-242)."""
+    tables = MAINTENANCE_TABLE_NAMES if update else SOURCE_TABLE_NAMES
+    for table in tables:
+        tdir = os.path.join(data_dir, table)
+        os.makedirs(tdir, exist_ok=True)
+        for child in range(range_start, range_end + 1):
+            src = os.path.join(data_dir, f"{table}_{child}_{parallel}.dat")
+            if os.path.exists(src):
+                shutil.move(src, tdir)
+
+
+def _merge_temp_tables(temp_dir: str, parent_dir: str,
+                       update: int | None) -> None:
+    """Move a --range run's per-table content up into the parent data dir
+    (reference: nds_gen_data.py:91-117)."""
+    tables = MAINTENANCE_TABLE_NAMES if update else SOURCE_TABLE_NAMES
+    for table in tables:
+        src_dir = os.path.join(temp_dir, table)
+        if not os.path.isdir(src_dir):
+            continue
+        dst_dir = os.path.join(parent_dir, table)
+        os.makedirs(dst_dir, exist_ok=True)
+        for f in os.listdir(src_dir):
+            shutil.move(os.path.join(src_dir, f), os.path.join(dst_dir, f))
+    shutil.rmtree(temp_dir, ignore_errors=True)
+
+
+def generate_data(args) -> None:
+    tool = check_build()
+    range_start, range_end = 1, int(args.parallel)
+    if args.range:
+        range_start, range_end = valid_range(args.range, args.parallel)
+
+    data_dir = get_abs_path(args.data_dir)
+    target_dir = data_dir
+    if args.range:
+        # incremental generation goes to a temp dir, then merges up; a stale
+        # temp dir from a failed prior run must not leak into the dataset
+        # (reference guards both sides: nds_gen_data.py clean_temp_data)
+        target_dir = os.path.join(data_dir, "_temp_")
+        shutil.rmtree(target_dir, ignore_errors=True)
+        os.makedirs(target_dir)
+    else:
+        if not os.path.isdir(data_dir):
+            os.makedirs(data_dir)
+        elif get_dir_size(data_dir) > 0 and not args.overwrite_output:
+            raise RuntimeError(
+                f"There's already data in {data_dir}; "
+                "use --overwrite_output to overwrite."
+            )
+
+    try:
+        _fanout(args, range_start, range_end, target_dir, tool)
+        _move_into_table_dirs(target_dir, range_start, range_end,
+                              int(args.parallel), args.update)
+        if args.range:
+            _merge_temp_tables(target_dir, data_dir, args.update)
+    except BaseException:
+        if args.range:
+            shutil.rmtree(target_dir, ignore_errors=True)
+        raise
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="Generate NDS benchmark data (native seeded generator)")
+    parser.add_argument("type", choices=["local", "dist"],
+                        help="fan-out mode: local multiprocess, or this "
+                        "host's slice of a multi-host run (use --range)")
+    parser.add_argument("scale", help="volume of data to generate in GB")
+    parser.add_argument("parallel", type=parallel_value_type,
+                        help="build data in <parallel_value> separate chunks")
+    parser.add_argument("data_dir", help="generate data in this directory")
+    parser.add_argument("--range",
+                        help='incremental generation: which child chunks to '
+                        'generate, "start,end" inclusive, within parallel')
+    parser.add_argument("--overwrite_output", action="store_true",
+                        help="overwrite existing data in the output path")
+    parser.add_argument("--update", type=int,
+                        help="generate refresh/update dataset <n> (one per "
+                        "throughput stream)")
+    parser.add_argument("--seed", type=int,
+                        help="base RNG seed (default: generator built-in)")
+    return parser
+
+
+if __name__ == "__main__":
+    generate_data(build_parser().parse_args())

@@ -1,0 +1,131 @@
+"""Data generator tests: determinism, chunking, schema conformance, refresh sets."""
+
+import os
+import subprocess
+
+import pytest
+
+from ndstpu import schema
+from ndstpu.check import check_build
+
+
+@pytest.fixture(scope="module")
+def tool():
+    return str(check_build())
+
+
+def run_gen(tool, outdir, *extra):
+    outdir.mkdir(parents=True, exist_ok=True)
+    subprocess.run([tool, "-scale", "0.01", "-dir", str(outdir), *extra],
+                   check=True)
+
+
+def test_all_tables_generated(tool, tmp_path):
+    run_gen(tool, tmp_path)
+    for t in schema.SOURCE_TABLE_NAMES:
+        assert (tmp_path / f"{t}_1_1.dat").exists(), t
+
+
+def test_field_counts_match_schema(tool, tmp_path):
+    run_gen(tool, tmp_path)
+    schemas = schema.get_schemas()
+    for t, s in schemas.items():
+        path = tmp_path / f"{t}_1_1.dat"
+        with open(path) as f:
+            line = f.readline().rstrip("\n")
+        # dsdgen convention: trailing '|' terminator -> n fields + empty tail
+        fields = line.split("|")
+        assert fields[-1] == "", f"{t}: missing trailing pipe"
+        assert len(fields) - 1 == len(s), (
+            f"{t}: {len(fields) - 1} fields vs {len(s)} schema columns")
+
+
+def test_chunking_is_deterministic(tool, tmp_path):
+    one = tmp_path / "one"
+    four = tmp_path / "four"
+    run_gen(tool, one, "-table", "customer")
+    for c in "1234":
+        run_gen(tool, four, "-parallel", "4", "-child", c, "-table", "customer")
+    whole = (one / "customer_1_1.dat").read_text()
+    parts = "".join(
+        (four / f"customer_{c}_4.dat").read_text() for c in "1234")
+    assert whole == parts
+
+
+def test_seed_changes_content(tool, tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    run_gen(tool, a, "-table", "item")
+    run_gen(tool, b, "-table", "item", "-seed", "42")
+    assert (a / "item_1_1.dat").read_text() != (b / "item_1_1.dat").read_text()
+
+
+def test_referential_integrity_returns(tool, tmp_path):
+    """store_returns rows must reference (ticket, item) pairs that exist in
+    store_sales — the generator re-derives parent sale values."""
+    run_gen(tool, tmp_path, "-table", "store_sales")
+    run_gen(tool, tmp_path, "-table", "store_returns")
+    sales = set()
+    for line in (tmp_path / "store_sales_1_1.dat").read_text().splitlines():
+        f = line.split("|")
+        sales.add((f[9], f[2]))  # (ss_ticket_number, ss_item_sk)
+    n = 0
+    for line in (tmp_path / "store_returns_1_1.dat").read_text().splitlines():
+        f = line.split("|")
+        assert (f[9], f[2]) in sales  # (sr_ticket_number, sr_item_sk)
+        n += 1
+    assert n > 0
+
+
+def test_date_dim_calendar(tool, tmp_path):
+    run_gen(tool, tmp_path, "-table", "date_dim")
+    lines = (tmp_path / "date_dim_1_1.dat").read_text().splitlines()
+    assert len(lines) == 73049
+    first = lines[0].split("|")
+    assert first[0] == "2415022" and first[2] == "1900-01-02"
+    assert first[14] == "Tuesday"
+    # spot-check a known date: 2000-01-01 was a Saturday
+    by_date = {l.split("|")[2]: l.split("|") for l in lines[36000:37500]}
+    row = by_date["2000-01-01"]
+    assert row[14] == "Saturday" and row[6] == "2000"
+
+
+def test_update_set(tool, tmp_path):
+    run_gen(tool, tmp_path, "-update", "1")
+    for t in schema.MAINTENANCE_TABLE_NAMES:
+        assert (tmp_path / f"{t}_1_1.dat").exists(), t
+    # delete tables: 3 date ranges each, date1 <= date2
+    for t in ("delete", "inventory_delete"):
+        lines = (tmp_path / f"{t}_1_1.dat").read_text().splitlines()
+        assert len(lines) == 3
+        for line in lines:
+            d1, d2, _ = line.split("|")
+            assert d1 <= d2
+
+
+def test_driver_cli(tool, tmp_path):
+    out = tmp_path / "data"
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    subprocess.run(
+        ["python", "-m", "ndstpu.datagen.driver", "local", "0.01", "2",
+         str(out)],
+        check=True, env=env)
+    # per-table dirs with chunk files inside
+    assert (out / "store_sales" / "store_sales_1_2.dat").exists()
+    assert (out / "store_sales" / "store_sales_2_2.dat").exists()
+    assert (out / "date_dim" / "date_dim_1_2.dat").exists()
+    # small tables may produce fewer chunks but the dir must exist
+    assert (out / "warehouse").is_dir()
+
+
+def test_driver_range_merge(tool, tmp_path):
+    out = tmp_path / "data"
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    for rng in ("1,2", "3,4"):
+        subprocess.run(
+            ["python", "-m", "ndstpu.datagen.driver", "local", "0.01", "4",
+             str(out), "--range", rng],
+            check=True, env=env)
+    files = sorted(os.listdir(out / "customer"))
+    assert files == [f"customer_{i}_4.dat" for i in (1, 2, 3, 4)]
+    assert not (out / "_temp_").exists()
