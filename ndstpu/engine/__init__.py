@@ -1,0 +1,6 @@
+"""The TPU columnar SQL engine.
+
+Pipeline: SQL text -> AST (sql/) -> logical plan (planner) -> optimized plan
+(optimizer) -> physical execution (physical/kernels) on numpy (reference
+interpreter) or JAX/XLA (TPU path).
+"""

@@ -1,0 +1,214 @@
+"""`ndslake` — a minimal ACID snapshot table format (Iceberg/Delta analog).
+
+The reference runs its data-maintenance phase (LF_*/DF_* refresh functions)
+against Iceberg or Delta Lake for ACID INSERT/DELETE plus time-travel
+rollback between repeated benchmark runs (nds_maintenance.py, nds_rollback.py:37-59).
+This module provides the same capabilities natively:
+
+Layout:
+    table_dir/
+      _ndslake/v{N:08d}.json   immutable snapshot manifests
+      _ndslake/CURRENT         pointer to the live snapshot version
+      data/part-*.parquet      immutable data files
+      deletes/d-*.npy          per-data-file deleted-row-index vectors
+
+Semantics:
+  * append(...)        -> new data file + new snapshot (INSERT INTO)
+  * delete_rows(...)   -> merge-on-read deletion vectors + new snapshot
+  * read(...)          -> current (or historical) table view
+  * rollback_to_timestamp / rollback_to_version -> move CURRENT
+    (undoes maintenance writes exactly like the reference's
+    `CALL spark_catalog.system.rollback_to_timestamp`)
+
+Writers are single-process per table (the benchmark's DM phase runs one
+maintenance stream per table family), so CURRENT is updated by atomic
+rename.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+@dataclass
+class Snapshot:
+    version: int
+    timestamp: float
+    # list of {"path": str, "rows": int, "deletes": Optional[str]}
+    files: List[Dict] = field(default_factory=list)
+    partition_col: Optional[str] = None
+    operation: str = "create"
+
+
+def _meta_dir(table_dir: str) -> str:
+    return os.path.join(table_dir, "_ndslake")
+
+
+def _snap_path(table_dir: str, version: int) -> str:
+    return os.path.join(_meta_dir(table_dir), f"v{version:08d}.json")
+
+
+def is_ndslake(table_dir: str) -> bool:
+    return os.path.isdir(_meta_dir(table_dir))
+
+
+def _write_snapshot(table_dir: str, snap: Snapshot) -> None:
+    os.makedirs(_meta_dir(table_dir), exist_ok=True)
+    with open(_snap_path(table_dir, snap.version), "w") as f:
+        json.dump({
+            "version": snap.version,
+            "timestamp": snap.timestamp,
+            "files": snap.files,
+            "partition_col": snap.partition_col,
+            "operation": snap.operation,
+        }, f, indent=1)
+    tmp = os.path.join(_meta_dir(table_dir), f".CURRENT.{uuid.uuid4().hex}")
+    with open(tmp, "w") as f:
+        f.write(str(snap.version))
+    os.replace(tmp, os.path.join(_meta_dir(table_dir), "CURRENT"))
+
+
+def current_version(table_dir: str) -> int:
+    with open(os.path.join(_meta_dir(table_dir), "CURRENT")) as f:
+        return int(f.read().strip())
+
+
+def _next_version(table_dir: str) -> int:
+    """Version numbers are monotonic over ALL snapshots ever written (not
+    CURRENT+1): after a rollback, new writes must not clobber the abandoned
+    branch's snapshot files."""
+    vs = [int(n[1:9]) for n in os.listdir(_meta_dir(table_dir))
+          if n.startswith("v") and n.endswith(".json")]
+    return max(vs) + 1 if vs else 0
+
+
+def load_snapshot(table_dir: str,
+                  version: Optional[int] = None) -> Snapshot:
+    if version is None:
+        version = current_version(table_dir)
+    with open(_snap_path(table_dir, version)) as f:
+        d = json.load(f)
+    return Snapshot(d["version"], d["timestamp"], d["files"],
+                    d.get("partition_col"), d.get("operation", "?"))
+
+
+def snapshots(table_dir: str) -> List[Snapshot]:
+    out = []
+    for name in sorted(os.listdir(_meta_dir(table_dir))):
+        if name.startswith("v") and name.endswith(".json"):
+            out.append(load_snapshot(table_dir, int(name[1:9])))
+    return out
+
+
+def _new_data_file(table_dir: str, at: pa.Table) -> Dict:
+    os.makedirs(os.path.join(table_dir, "data"), exist_ok=True)
+    rel = os.path.join("data", f"part-{uuid.uuid4().hex}.parquet")
+    pq.write_table(at, os.path.join(table_dir, rel), compression="snappy")
+    return {"path": rel, "rows": at.num_rows, "deletes": None}
+
+
+def create_table(table_dir: str, at: pa.Table,
+                 partition_col: Optional[str] = None) -> None:
+    """Create/overwrite a table with an initial snapshot (CTAS analog)."""
+    os.makedirs(table_dir, exist_ok=True)
+    if partition_col is not None:
+        at = at.sort_by([(partition_col, "ascending")])
+    snap = Snapshot(0, time.time(), [_new_data_file(table_dir, at)],
+                    partition_col, "create")
+    _write_snapshot(table_dir, snap)
+
+
+def append(table_dir: str, at: pa.Table) -> None:
+    """INSERT INTO: add a data file in a new snapshot."""
+    prev = load_snapshot(table_dir)
+    if prev.partition_col is not None and prev.partition_col in at.column_names:
+        at = at.sort_by([(prev.partition_col, "ascending")])
+    snap = Snapshot(_next_version(table_dir), time.time(),
+                    prev.files + [_new_data_file(table_dir, at)],
+                    prev.partition_col, "append")
+    _write_snapshot(table_dir, snap)
+
+
+def delete_rows(table_dir: str,
+                predicate: Callable[[pa.Table], np.ndarray]) -> int:
+    """DELETE FROM ... WHERE: merge-on-read deletion vectors.
+
+    `predicate` maps a data-file's (live-row) arrow table to a boolean
+    delete-mask over those rows.  Returns number of rows deleted."""
+    prev = load_snapshot(table_dir)
+    os.makedirs(os.path.join(table_dir, "deletes"), exist_ok=True)
+    new_files: List[Dict] = []
+    total = 0
+    for fmeta in prev.files:
+        at = pq.read_table(os.path.join(table_dir, fmeta["path"]))
+        already = (np.load(os.path.join(table_dir, fmeta["deletes"]))
+                   if fmeta["deletes"] else
+                   np.empty(0, dtype=np.int64))
+        live = np.ones(at.num_rows, dtype=bool)
+        live[already] = False
+        live_idx = np.nonzero(live)[0]
+        mask = np.asarray(predicate(at.take(live_idx)), dtype=bool)
+        kill = live_idx[mask]
+        total += len(kill)
+        if len(kill) == 0:
+            new_files.append(dict(fmeta))
+            continue
+        alldel = np.union1d(already, kill).astype(np.int64)
+        rel = os.path.join("deletes", f"d-{uuid.uuid4().hex}.npy")
+        np.save(os.path.join(table_dir, rel), alldel)
+        nf = dict(fmeta)
+        nf["deletes"] = rel
+        new_files.append(nf)
+    snap = Snapshot(_next_version(table_dir), time.time(), new_files,
+                    prev.partition_col, "delete")
+    _write_snapshot(table_dir, snap)
+    return total
+
+
+def read(table_dir: str, version: Optional[int] = None,
+         columns: Optional[List[str]] = None) -> pa.Table:
+    """Current (or historical) view of the table."""
+    snap = load_snapshot(table_dir, version)
+    parts = []
+    for fmeta in snap.files:
+        at = pq.read_table(os.path.join(table_dir, fmeta["path"]),
+                           columns=columns)
+        if fmeta["deletes"]:
+            dels = np.load(os.path.join(table_dir, fmeta["deletes"]))
+            keep = np.ones(at.num_rows, dtype=bool)
+            keep[dels] = False
+            at = at.filter(pa.array(keep))
+        parts.append(at)
+    return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+
+
+def rollback_to_version(table_dir: str, version: int) -> int:
+    """Restore the state of snapshot `version` by writing a NEW snapshot
+    with its file list (Iceberg-style: history stays linear and monotonic,
+    so later timestamp rollbacks can't resurrect an abandoned branch).
+    Returns the new snapshot's version."""
+    target = load_snapshot(table_dir, version)
+    snap = Snapshot(_next_version(table_dir), time.time(),
+                    [dict(f) for f in target.files], target.partition_col,
+                    f"rollback(v{version})")
+    _write_snapshot(table_dir, snap)
+    return snap.version
+
+
+def rollback_to_timestamp(table_dir: str, ts: float) -> int:
+    """Restore the newest snapshot at-or-before `ts`
+    (reference parity: nds_rollback.py:37-59)."""
+    candidates = [s for s in snapshots(table_dir) if s.timestamp <= ts]
+    if not candidates:
+        raise ValueError(f"no snapshot at or before {ts}")
+    target = max(candidates, key=lambda s: s.version)
+    return rollback_to_version(table_dir, target.version)

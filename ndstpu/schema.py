@@ -67,6 +67,7 @@ INT64 = DType("int64")
 FLOAT64 = DType("float64")
 DATE = DType("date")
 STRING = DType("string")
+BOOL = DType("bool")
 
 
 def decimal(precision: int, scale: int) -> DType:
