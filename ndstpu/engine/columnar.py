@@ -287,12 +287,24 @@ def _coerce_to_spec(arr, spec_dtype: DType):
             if pa.types.is_timestamp(typ):
                 return arr.cast(pa.date32())
             if pa.types.is_string(typ) or pa.types.is_large_string(typ):
-                return arr.cast(pa.timestamp("s")).cast(pa.date32())
+                return arr.cast(pa.timestamp("ms")).cast(pa.date32())
+            if pa.types.is_integer(typ) or pa.types.is_floating(typ):
+                # numeric dates from lossy formats: epoch-ms vs epoch-days by
+                # magnitude (days fit well under 1e7; ms are > 1e10)
+                import pyarrow.compute as pc
+                vals = arr.cast(pa.int64())
+                if len(vals) and pc.max(pc.abs(vals)).as_py() > 10**7:
+                    vals = pc.divide(vals, 86_400_000)
+                return vals.cast(pa.int32()).cast(pa.date32())
         if k == "float64" and not pa.types.is_floating(typ):
             return arr.cast(pa.float64())
-        if k in ("int32", "int64") and not pa.types.is_integer(typ):
+        if k in ("int32", "int64") and typ != (
+                pa.int64() if k == "int64" else pa.int32()):
             return arr.cast(pa.int64() if k == "int64" else pa.int32())
-    except pa.ArrowInvalid:
+    except pa.ArrowInvalid as exc:
+        import warnings
+        warnings.warn(f"schema coercion to {spec_dtype} failed: {exc}; "
+                      "keeping source type", RuntimeWarning)
         return arr
     return arr
 

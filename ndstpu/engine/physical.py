@@ -1,0 +1,657 @@
+"""Physical execution of logical plans — numpy reference interpreter.
+
+This is the engine's exact-semantics path: it executes any supported plan on
+host numpy arrays with Spark-compatible NULL, decimal and ordering semantics.
+It doubles as the differential baseline for the TPU path (the analog of the
+reference's CPU-Spark-vs-GPU-rapids validation, nds_validate.py).
+
+Algorithms are all vectorized columnar:
+  joins        sort+searchsorted two-sided expansion (supports N:M)
+  group-by     per-key factorize -> mixed-radix combine -> bincount/reduceat
+  rollup       re-aggregation per grouping set
+  windows      partition factorize -> lexsort -> segmented scans
+  sort         numpy lexsort, Spark null ordering (asc=NULLS FIRST)
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ndstpu.engine import columnar, expr as ex, plan as lp
+from ndstpu.engine.columnar import (
+    BOOL,
+    FLOAT64,
+    INT32,
+    INT64,
+    STRING,
+    Column,
+    Table,
+    decimal,
+)
+
+
+class Executor:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # -- entry ---------------------------------------------------------------
+
+    def execute(self, p: lp.Plan) -> Table:
+        m = getattr(self, "_exec_" + type(p).__name__.lower())
+        return m(p)
+
+    # -- leaves --------------------------------------------------------------
+
+    def _exec_scan(self, p: lp.Scan) -> Table:
+        t = self.catalog.get(p.table)
+        if p.predicate is not None:
+            t = t.filter(ex.eval_predicate(t, p.predicate))
+        if p.columns is not None:
+            t = t.select([c for c in p.columns])
+        return t
+
+    def _exec_inlinetable(self, p: lp.InlineTable) -> Table:
+        return p.table
+
+    def _exec_subqueryalias(self, p: lp.SubqueryAlias) -> Table:
+        t = self.execute(p.child)
+        if p.column_aliases:
+            t = Table(dict(zip(p.column_aliases, t.columns.values())))
+        return t
+
+    # -- row ops -------------------------------------------------------------
+
+    def _exec_filter(self, p: lp.Filter) -> Table:
+        t = self.execute(p.child)
+        return t.filter(ex.eval_predicate(t, p.condition))
+
+    def _exec_project(self, p: lp.Project) -> Table:
+        t = self.execute(p.child)
+        ev = ex.Evaluator(t)
+        return Table({name: ev.eval(e) for name, e in p.exprs})
+
+    def _exec_limit(self, p: lp.Limit) -> Table:
+        return self.execute(p.child).head(p.n)
+
+    # -- join ----------------------------------------------------------------
+
+    def _join_key_array(self, t: Table, exprs: Sequence[ex.Expr],
+                        other: Optional[List[Column]] = None):
+        """Evaluate join key exprs to comparable numpy arrays + validity."""
+        ev = ex.Evaluator(t)
+        cols = [ev.eval(e) for e in exprs]
+        return cols
+
+    def _align_key_pair(self, lc: Column, rc: Column):
+        if lc.ctype.kind == "string" or rc.ctype.kind == "string":
+            merged = columnar.merge_dictionaries([lc, rc])
+            return (columnar.translate_codes(lc, merged).astype(np.int64),
+                    columnar.translate_codes(rc, merged).astype(np.int64))
+        if lc.ctype.kind == "decimal" or rc.ctype.kind == "decimal":
+            s = max(lc.ctype.scale if lc.ctype.kind == "decimal" else 0,
+                    rc.ctype.scale if rc.ctype.kind == "decimal" else 0)
+            t = decimal(38, s)
+            return (ex.cast_column(lc, t).data.astype(np.int64),
+                    ex.cast_column(rc, t).data.astype(np.int64))
+        return lc.data.astype(np.int64), rc.data.astype(np.int64)
+
+    def _composite_keys(self, lt: Table, rt: Table,
+                        keys: List[Tuple[ex.Expr, ex.Expr]]):
+        lcols = [ex.Evaluator(lt).eval(le) for le, _ in keys]
+        rcols = [ex.Evaluator(rt).eval(re_) for _, re_ in keys]
+        lvalid = np.ones(lt.num_rows, dtype=bool)
+        rvalid = np.ones(rt.num_rows, dtype=bool)
+        lparts, rparts = [], []
+        for lc, rc in zip(lcols, rcols):
+            la, ra = self._align_key_pair(lc, rc)
+            lvalid &= lc.validity()
+            rvalid &= rc.validity()
+            lparts.append(la)
+            rparts.append(ra)
+        # factorize each part jointly so composite fits in int64
+        lkey = np.zeros(lt.num_rows, dtype=np.int64)
+        rkey = np.zeros(rt.num_rows, dtype=np.int64)
+        for la, ra in zip(lparts, rparts):
+            both = np.concatenate([la, ra])
+            uniq, inv = np.unique(both, return_inverse=True)
+            k = len(uniq) + 1
+            lkey = lkey * k + inv[:len(la)] + 1
+            rkey = rkey * k + inv[len(la):] + 1
+        return lkey, rkey, lvalid, rvalid
+
+    def _exec_join(self, p: lp.Join) -> Table:
+        lt = self.execute(p.left)
+        rt = self.execute(p.right)
+        kind = p.kind
+        if kind == "cross" or not p.keys:
+            out = self._cross_join(lt, rt)
+            if p.extra is not None and kind in ("inner", "cross"):
+                out = out.filter(ex.eval_predicate(out, p.extra))
+                return out
+            if kind in ("inner", "cross"):
+                return out
+            # non-equi outer joins: fall back to per-kind handling below
+            raise NotImplementedError(f"non-equi {kind} join")
+        lkey, rkey, lvalid, rvalid = self._composite_keys(lt, rt, p.keys)
+        # null keys never match
+        lkey = np.where(lvalid, lkey, -1)
+        rkey = np.where(rvalid, rkey, -2)
+
+        order = np.argsort(rkey, kind="stable")
+        rsorted = rkey[order]
+        lo = np.searchsorted(rsorted, lkey, side="left")
+        hi = np.searchsorted(rsorted, lkey, side="right")
+        counts = (hi - lo)
+        matched = counts > 0
+
+        if kind in ("semi", "anti"):
+            mask = matched if kind == "semi" else ~matched
+            if p.extra is not None and kind == "semi":
+                # re-run as inner join + distinct-left for residual predicate
+                inner = self._expand_join(lt, rt, order, lo, hi, counts)
+                keep = ex.eval_predicate(inner, p.extra)
+                li = self._expand_left_indices(counts)[keep]
+                mask = np.zeros(lt.num_rows, dtype=bool)
+                mask[li] = True
+            elif p.extra is not None and kind == "anti":
+                inner = self._expand_join(lt, rt, order, lo, hi, counts)
+                keep = ex.eval_predicate(inner, p.extra)
+                li = self._expand_left_indices(counts)[keep]
+                mask = np.ones(lt.num_rows, dtype=bool)
+                mask[li] = False
+            return lt.filter(mask)
+
+        if kind == "inner":
+            out = self._expand_join(lt, rt, order, lo, hi, counts)
+            if p.extra is not None:
+                out = out.filter(ex.eval_predicate(out, p.extra))
+            return out
+
+        if kind == "left":
+            return self._left_join(lt, rt, order, lo, hi, counts, p.extra)
+        if kind == "right":
+            flipped = lp.Join(p.right, p.left, "left",
+                              [(r, l) for l, r in p.keys], p.extra)
+            out = self._exec_join_pre(rt, lt, flipped)
+            # restore column order: left table columns first
+            names = list(lt.columns) + list(rt.columns)
+            return Table({n: out.columns[n] for n in names})
+        if kind == "full":
+            left_part = self._left_join(lt, rt, order, lo, hi, counts, p.extra)
+            # right rows with no left match
+            rorder = np.argsort(lkey, kind="stable")
+            lsorted = lkey[rorder]
+            rmatched = np.searchsorted(lsorted, rkey, "left") != \
+                np.searchsorted(lsorted, rkey, "right")
+            runmatched = rt.filter(~rmatched)
+            nullleft = self._null_table(lt, runmatched.num_rows)
+            bottom = Table({**nullleft.columns, **runmatched.columns})
+            return Table.concat([left_part, bottom])
+        raise NotImplementedError(f"join kind {kind}")
+
+    def _exec_join_pre(self, lt, rt, p: lp.Join) -> Table:
+        lkey, rkey, lvalid, rvalid = self._composite_keys(lt, rt, p.keys)
+        lkey = np.where(lvalid, lkey, -1)
+        rkey = np.where(rvalid, rkey, -2)
+        order = np.argsort(rkey, kind="stable")
+        rsorted = rkey[order]
+        lo = np.searchsorted(rsorted, lkey, side="left")
+        hi = np.searchsorted(rsorted, lkey, side="right")
+        counts = hi - lo
+        return self._left_join(lt, rt, order, lo, hi, counts, p.extra)
+
+    @staticmethod
+    def _expand_left_indices(counts: np.ndarray) -> np.ndarray:
+        return np.repeat(np.arange(len(counts)), counts)
+
+    @staticmethod
+    def _expand_right_positions(lo, counts) -> np.ndarray:
+        total = int(counts.sum())
+        if total == 0:
+            return np.empty(0, dtype=np.int64)
+        # ragged arange: for each left row i, positions lo[i]..lo[i]+counts[i]
+        idx = np.repeat(lo, counts)
+        within = np.arange(total) - np.repeat(
+            np.cumsum(counts) - counts, counts)
+        return idx + within
+
+    def _expand_join(self, lt, rt, order, lo, hi, counts) -> Table:
+        li = self._expand_left_indices(counts)
+        rpos = self._expand_right_positions(lo, counts)
+        ri = order[rpos]
+        return Table({**lt.gather(li).columns, **rt.gather(ri).columns})
+
+    def _left_join(self, lt, rt, order, lo, hi, counts, extra) -> Table:
+        li = self._expand_left_indices(counts)
+        rpos = self._expand_right_positions(lo, counts)
+        ri = order[rpos]
+        matched_tbl = Table({**lt.gather(li).columns,
+                             **rt.gather(ri).columns})
+        if extra is not None:
+            keep = ex.eval_predicate(matched_tbl, extra)
+            matched_tbl = matched_tbl.filter(keep)
+            li = li[keep]
+        # left rows with zero surviving matches
+        hitcount = np.bincount(li, minlength=lt.num_rows)
+        unmatched = lt.filter(hitcount == 0)
+        nullright = self._null_table(rt, unmatched.num_rows)
+        bottom = Table({**unmatched.columns, **nullright.columns})
+        return Table.concat([matched_tbl, bottom])
+
+    @staticmethod
+    def _null_table(template: Table, n: int) -> Table:
+        cols = {}
+        for name, c in template.columns.items():
+            data = np.zeros(n, dtype=c.data.dtype)
+            cols[name] = Column(data, c.ctype, np.zeros(n, dtype=bool),
+                                c.dictionary)
+        return Table(cols)
+
+    def _cross_join(self, lt: Table, rt: Table) -> Table:
+        li = np.repeat(np.arange(lt.num_rows), rt.num_rows)
+        ri = np.tile(np.arange(rt.num_rows), lt.num_rows)
+        return Table({**lt.gather(li).columns, **rt.gather(ri).columns})
+
+    # -- aggregate -----------------------------------------------------------
+
+    def _factorize(self, cols: List[Column]) -> Tuple[np.ndarray, np.ndarray]:
+        """Composite group ids + representative first-row index per group."""
+        n = len(cols[0].data) if cols else 0
+        gid = np.zeros(n, dtype=np.int64)
+        for c in cols:
+            data = c.data.astype(np.int64)
+            data = np.where(c.validity(), data, np.int64(-(2**62)))
+            uniq, inv = np.unique(data, return_inverse=True)
+            gid = gid * (len(uniq) + 1) + inv
+            if len(uniq) + 1 > 2**31:
+                uniq2, gid = np.unique(gid, return_inverse=True)
+        uniq, first, inv = np.unique(gid, return_index=True,
+                                     return_inverse=True)
+        return inv.astype(np.int64), first
+
+    def _exec_aggregate(self, p: lp.Aggregate) -> Table:
+        t = self.execute(p.child)
+        if p.grouping_sets is None:
+            return self._aggregate_once(t, p.group_by, p.aggs, None)
+        parts = []
+        for subset in p.grouping_sets:
+            parts.append(self._aggregate_once(t, p.group_by, p.aggs, subset))
+        return Table.concat(parts)
+
+    def _aggregate_once(self, t: Table, group_by, aggs,
+                        subset: Optional[List[int]]) -> Table:
+        ev = ex.Evaluator(t)
+        key_cols = []
+        for i, (name, e) in enumerate(group_by):
+            c = ev.eval(e)
+            if subset is not None and i not in subset:
+                # excluded key in this grouping set -> all NULL
+                c = Column(np.zeros_like(c.data), c.ctype,
+                           np.zeros(len(c.data), dtype=bool), c.dictionary)
+            key_cols.append((name, c))
+        n = t.num_rows
+        if key_cols:
+            gids, first = self._factorize([c for _, c in key_cols])
+            ngroups = len(first)
+        else:
+            gids = np.zeros(n, dtype=np.int64)
+            first = np.array([0], dtype=np.int64) if n else np.array([0])
+            ngroups = 1
+        out: Dict[str, Column] = {}
+        for name, c in key_cols:
+            if n:
+                out[name] = c.gather(first)
+            else:
+                out[name] = Column(np.zeros(0, c.data.dtype), c.ctype,
+                                   np.zeros(0, dtype=bool), c.dictionary)
+        for name, e in aggs:
+            out[name] = self._eval_agg(t, e, gids, ngroups, n)
+        if not key_cols and n == 0:
+            # global aggregate over empty input still yields one row
+            pass
+        return Table(out)
+
+    def _eval_agg(self, t: Table, e: ex.Expr, gids, ngroups, n) -> Column:
+        """Evaluate an aggregate output expression — either a bare AggExpr or
+        an arithmetic expression over AggExprs (e.g. sum(a)/sum(b))."""
+        if isinstance(e, ex.AggExpr):
+            return self._agg_column(t, e, gids, ngroups, n)
+        if isinstance(e, ex.BinOp):
+            lc = self._eval_agg(t, e.left, gids, ngroups, n)
+            rc = self._eval_agg(t, e.right, gids, ngroups, n)
+            tbl = Table({"__l": lc, "__r": rc})
+            return ex.Evaluator(tbl).eval(
+                ex.BinOp(e.op, ex.ColumnRef("__l"), ex.ColumnRef("__r")))
+        if isinstance(e, ex.Cast):
+            return ex.cast_column(
+                self._eval_agg(t, e.operand, gids, ngroups, n), e.target)
+        if isinstance(e, ex.Func):
+            cols = {f"__a{i}": self._eval_agg(t, a, gids, ngroups, n)
+                    for i, a in enumerate(e.args)}
+            tbl = Table(cols)
+            return ex.Evaluator(tbl).eval(
+                ex.Func(e.name, tuple(ex.ColumnRef(f"__a{i}")
+                                      for i in range(len(e.args)))))
+        if isinstance(e, ex.Case):
+            # CASE over aggregate results
+            whens = []
+            cols = {}
+            idx = 0
+
+            def sub(expr):
+                nonlocal idx
+                name = f"__c{idx}"
+                idx += 1
+                cols[name] = self._eval_agg(t, expr, gids, ngroups, n)
+                return ex.ColumnRef(name)
+            whens = tuple((sub(c), sub(v)) for c, v in e.whens)
+            default = sub(e.default) if e.default is not None else None
+            return ex.Evaluator(Table(cols)).eval(ex.Case(whens, default))
+        if isinstance(e, ex.Literal):
+            return ex.literal_column(e.value, ngroups, e.ctype)
+        raise NotImplementedError(f"aggregate output expr {e}")
+
+    def _agg_column(self, t: Table, a: ex.AggExpr, gids, ngroups,
+                    n) -> Column:
+        func = a.func
+        if isinstance(a.arg, ex.Star):
+            counts = np.bincount(gids, minlength=ngroups) if n else \
+                np.zeros(ngroups, dtype=np.int64)
+            return Column(counts.astype(np.int64), INT64)
+        c = ex.Evaluator(t).eval(a.arg)
+        valid = c.validity()
+        if a.distinct:
+            # keep one row per (gid, value)
+            comp = np.stack([gids, c.data.astype(np.int64)], axis=1) \
+                if n else np.zeros((0, 2), dtype=np.int64)
+            comp = comp[valid]
+            if len(comp):
+                _, uidx = np.unique(comp, axis=0, return_index=True)
+                sel = np.zeros(len(comp), dtype=bool)
+                sel[uidx] = True
+                sub_g = comp[sel, 0]
+                sub_v = comp[sel, 1]
+            else:
+                sub_g = np.zeros(0, dtype=np.int64)
+                sub_v = np.zeros(0, dtype=np.int64)
+            if func == "count":
+                counts = np.bincount(sub_g, minlength=ngroups)
+                return Column(counts.astype(np.int64), INT64)
+            if func == "sum":
+                sums = np.bincount(sub_g, weights=sub_v.astype(np.float64),
+                                   minlength=ngroups)
+                got = np.bincount(sub_g, minlength=ngroups) > 0
+                return self._sum_result(c, sums, got)
+            if func == "avg":
+                sums = np.bincount(sub_g, weights=sub_v.astype(np.float64),
+                                   minlength=ngroups)
+                cnts = np.bincount(sub_g, minlength=ngroups)
+                return self._avg_result(c, sums, cnts)
+            raise NotImplementedError(f"distinct {func}")
+        if func == "count":
+            counts = np.bincount(gids[valid], minlength=ngroups) if n else \
+                np.zeros(ngroups, dtype=np.int64)
+            return Column(counts.astype(np.int64), INT64)
+        got = (np.bincount(gids[valid], minlength=ngroups) > 0) if n else \
+            np.zeros(ngroups, dtype=bool)
+        if func == "sum":
+            if n:
+                if c.ctype.kind in ("decimal", "int32", "int64"):
+                    sums = np.zeros(ngroups, dtype=np.int64)
+                    np.add.at(sums, gids[valid],
+                              c.data[valid].astype(np.int64))
+                else:
+                    sums = np.bincount(
+                        gids[valid],
+                        weights=c.data[valid].astype(np.float64),
+                        minlength=ngroups)
+            else:
+                sums = np.zeros(ngroups)
+            return self._sum_result(c, sums, got)
+        if func == "avg":
+            if n:
+                sums = np.bincount(gids[valid],
+                                   weights=c.data[valid].astype(np.float64),
+                                   minlength=ngroups)
+                cnts = np.bincount(gids[valid], minlength=ngroups)
+            else:
+                sums = np.zeros(ngroups)
+                cnts = np.zeros(ngroups, dtype=np.int64)
+            return self._avg_result(c, sums, cnts)
+        if func in ("min", "max"):
+            if not n:
+                return Column(np.zeros(ngroups, c.data.dtype), c.ctype,
+                              np.zeros(ngroups, dtype=bool), c.dictionary)
+            if c.ctype.kind == "string":
+                data = c.data.astype(np.int64)
+            else:
+                data = c.data
+            out = np.zeros(ngroups, dtype=data.dtype)
+            init = (np.iinfo(data.dtype).max if data.dtype.kind in "iu"
+                    else np.inf) if func == "min" else \
+                   (np.iinfo(data.dtype).min if data.dtype.kind in "iu"
+                    else -np.inf)
+            out[:] = init
+            opfn = np.minimum if func == "min" else np.maximum
+            opfn.at(out, gids[valid], data[valid])
+            return Column(out.astype(c.data.dtype), c.ctype,
+                          None if got.all() else got, c.dictionary)
+        if func in ("stddev_samp", "var_samp", "stddev", "variance"):
+            x = ex.cast_column(c, FLOAT64).data
+            if n:
+                s1 = np.bincount(gids[valid], weights=x[valid],
+                                 minlength=ngroups)
+                s2 = np.bincount(gids[valid], weights=x[valid] ** 2,
+                                 minlength=ngroups)
+                cnt = np.bincount(gids[valid], minlength=ngroups)
+            else:
+                s1 = s2 = np.zeros(ngroups)
+                cnt = np.zeros(ngroups, dtype=np.int64)
+            ok = cnt > 1
+            denom = np.where(ok, cnt - 1, 1)
+            var = np.maximum(
+                (s2 - np.where(cnt > 0, s1 ** 2 / np.maximum(cnt, 1), 0.0)),
+                0.0) / denom
+            data = var if func in ("var_samp", "variance") else np.sqrt(var)
+            return Column(data, FLOAT64, None if ok.all() else ok)
+        raise NotImplementedError(f"aggregate {func}")
+
+    def _sum_result(self, c: Column, sums: np.ndarray,
+                    got: np.ndarray) -> Column:
+        vopt = None if got.all() else got
+        if c.ctype.kind == "decimal":
+            return Column(sums.astype(np.int64),
+                          decimal(38, c.ctype.scale), vopt)
+        if c.ctype.kind in ("int32", "int64"):
+            return Column(sums.astype(np.int64), INT64, vopt)
+        return Column(sums.astype(np.float64), FLOAT64, vopt)
+
+    def _avg_result(self, c: Column, sums: np.ndarray,
+                    cnts: np.ndarray) -> Column:
+        got = cnts > 0
+        denom = np.maximum(cnts, 1)
+        if c.ctype.kind == "decimal":
+            data = sums / denom / (10 ** c.ctype.scale)
+        else:
+            data = sums / denom
+        return Column(data, FLOAT64, None if got.all() else got)
+
+    # -- distinct / set ops --------------------------------------------------
+
+    def _row_ids(self, t: Table) -> np.ndarray:
+        gids, _ = self._factorize(list(t.columns.values()))
+        return gids
+
+    def _exec_distinct(self, p: lp.Distinct) -> Table:
+        t = self.execute(p.child)
+        if t.num_rows == 0:
+            return t
+        gids, first = self._factorize(list(t.columns.values()))
+        return t.gather(np.sort(first))
+
+    def _exec_setop(self, p: lp.SetOp) -> Table:
+        lt = self.execute(p.left)
+        rt = self.execute(p.right)
+        rt = Table(dict(zip(lt.column_names, rt.columns.values())))
+        if p.kind == "union":
+            both = Table.concat([lt, rt])
+            if p.all:
+                return both
+            return self._exec_distinct(lp.Distinct(lp.InlineTable(both)))
+        both = Table.concat([lt, rt])
+        gids, first = self._factorize(list(both.columns.values()))
+        nl = lt.num_rows
+        in_left = np.zeros(gids.max() + 1 if len(gids) else 0, dtype=bool)
+        in_right = np.zeros_like(in_left)
+        if len(gids):
+            in_left[gids[:nl]] = True
+            in_right[gids[nl:]] = True
+        if p.kind == "intersect":
+            keepg = in_left & in_right
+        else:  # except
+            keepg = in_left & ~in_right
+        # representative first row from the left side per kept group
+        lt_gids = gids[:nl]
+        seen = np.zeros_like(in_left)
+        keep_rows = np.zeros(nl, dtype=bool)
+        if nl:
+            firstl = np.full(len(in_left), -1, dtype=np.int64)
+            # first occurrence per group on left side
+            rev = np.arange(nl - 1, -1, -1)
+            firstl[lt_gids[rev]] = rev
+            sel = firstl[(firstl >= 0) & keepg[np.arange(len(firstl))]] \
+                if len(firstl) else np.empty(0, np.int64)
+            keep_rows[sel.astype(np.int64)] = True
+        return lt.filter(keep_rows)
+
+    # -- window --------------------------------------------------------------
+
+    def _exec_window(self, p: lp.Window) -> Table:
+        t = self.execute(p.child)
+        out = dict(t.columns)
+        for name, e in p.exprs:
+            assert isinstance(e, ex.WindowExpr)
+            out[name] = self._window_column(t, e)
+        return Table(out)
+
+    def _window_column(self, t: Table, w: ex.WindowExpr) -> Column:
+        n = t.num_rows
+        ev = ex.Evaluator(t)
+        if w.partition_by:
+            pcols = [ev.eval(e) for e in w.partition_by]
+            pid, _ = self._factorize(pcols)
+        else:
+            pid = np.zeros(n, dtype=np.int64)
+        sort_arrays = [pid]
+        for e, asc in reversed(list(w.order_by)):
+            c = ev.eval(e)
+            key = self._order_key(c, asc)
+            sort_arrays.insert(0, key)
+        order = np.lexsort(sort_arrays[::-1]) if n else np.zeros(0, np.int64)
+        inv = np.empty(n, dtype=np.int64)
+        inv[order] = np.arange(n)
+        pid_s = pid[order]
+        newpart = np.ones(n, dtype=bool)
+        if n > 1:
+            newpart[1:] = pid_s[1:] != pid_s[:-1]
+        pos_in_part = np.arange(n) - np.maximum.accumulate(
+            np.where(newpart, np.arange(n), 0))
+        if w.func == "row_number":
+            return Column((pos_in_part + 1)[inv].astype(np.int64), INT64)
+        if w.func in ("rank", "dense_rank"):
+            okeys = [a[order] for a in sort_arrays[:-1]]
+            tie = np.zeros(n, dtype=bool)
+            if n > 1:
+                tie[1:] = np.ones(n - 1, dtype=bool)
+                for a in okeys:
+                    tie[1:] &= a[1:] == a[:-1]
+                tie[1:] &= ~newpart[1:]
+            if w.func == "rank":
+                # rank = 1 + pos of the first row of the current tie run;
+                # tie is False at partition starts so the forward fill of the
+                # last non-tie index never crosses partitions
+                idx = np.arange(n)
+                last_nontie = np.maximum.accumulate(np.where(~tie, idx, -1))
+                ranks = pos_in_part[last_nontie] + 1
+            else:
+                incr = (~tie).astype(np.int64)
+                incr = np.where(newpart, 0, incr)
+                # dense rank: cumulative distinct count within partition
+                c = np.cumsum(incr)
+                base = np.maximum.accumulate(np.where(newpart, c, 0))
+                ranks = c - base + 1
+            return Column(ranks[inv].astype(np.int64), INT64)
+        # aggregate window over whole partition (no frame support yet)
+        arg = ev.eval(w.arg) if w.arg is not None and \
+            not isinstance(w.arg, ex.Star) else None
+        if w.func == "count" and arg is None:
+            cnt = np.bincount(pid, minlength=int(pid.max()) + 1 if n else 0)
+            return Column(cnt[pid].astype(np.int64), INT64)
+        valid = arg.validity()
+        x = arg.data.astype(np.float64)
+        ng = int(pid.max()) + 1 if n else 0
+        sums = np.bincount(pid[valid], weights=x[valid], minlength=ng)
+        cnts = np.bincount(pid[valid], minlength=ng)
+        if w.func == "sum":
+            got = cnts[pid] > 0
+            if arg.ctype.kind == "decimal":
+                tot = np.zeros(ng, dtype=np.int64)
+                np.add.at(tot, pid[valid], arg.data[valid].astype(np.int64))
+                return Column(tot[pid], decimal(38, arg.ctype.scale),
+                              None if got.all() else got)
+            return Column(sums[pid], FLOAT64, None if got.all() else got)
+        if w.func == "avg":
+            got = cnts[pid] > 0
+            mean = sums / np.maximum(cnts, 1)
+            if arg.ctype.kind == "decimal":
+                mean = mean / (10 ** arg.ctype.scale)
+            return Column(mean[pid], FLOAT64, None if got.all() else got)
+        if w.func in ("min", "max"):
+            data = arg.data
+            out = np.full(ng, np.iinfo(np.int64).max if func_min(w.func)
+                          else np.iinfo(np.int64).min, dtype=np.int64)
+            opfn = np.minimum if w.func == "min" else np.maximum
+            opfn.at(out, pid[valid], data[valid].astype(np.int64))
+            got = cnts[pid] > 0
+            return Column(out[pid].astype(arg.data.dtype), arg.ctype,
+                          None if got.all() else got, arg.dictionary)
+        if w.func == "count":
+            return Column(cnts[pid].astype(np.int64), INT64)
+        raise NotImplementedError(f"window {w.func}")
+
+    # -- sort ----------------------------------------------------------------
+
+    def _order_key(self, c: Column, asc: bool) -> np.ndarray:
+        """Sortable int64/float key with Spark null ordering:
+        ASC -> NULLS FIRST, DESC -> NULLS LAST (both = nulls smallest)."""
+        if c.ctype.kind == "float64":
+            data = c.data.astype(np.float64)
+            v = c.validity()
+            data = np.where(v, data, -np.inf)
+            return data if asc else -data
+        data = c.data.astype(np.int64)
+        v = c.validity()
+        data = np.where(v, data, np.int64(-2**62))
+        return data if asc else -data
+
+    def _exec_sort(self, p: lp.Sort) -> Table:
+        t = self.execute(p.child)
+        if t.num_rows == 0:
+            return t
+        ev = ex.Evaluator(t)
+        keys = []
+        for e, asc in p.keys:
+            keys.append(self._order_key(ev.eval(e), asc))
+        order = np.lexsort(keys[::-1])
+        return t.gather(order)
+
+
+def func_min(name: str) -> bool:
+    return name == "min"
+
+
+def execute(plan: lp.Plan, catalog) -> Table:
+    return Executor(catalog).execute(plan)

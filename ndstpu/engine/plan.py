@@ -1,0 +1,177 @@
+"""Logical plan nodes.
+
+Produced by the planner from SQL ASTs, rewritten by the optimizer, executed
+by ndstpu.engine.physical (numpy interpreter) or compiled by
+ndstpu.engine.kernels (jax/TPU path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ndstpu.engine.expr import Expr
+
+
+class Plan:
+    def children(self) -> Sequence["Plan"]:
+        return ()
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+
+@dataclasses.dataclass
+class Scan(Plan):
+    table: str
+    alias: str
+    # column projection filled by the optimizer (None = all)
+    columns: Optional[List[str]] = None
+    # pushed-down predicate (in terms of output names)
+    predicate: Optional[Expr] = None
+
+    def __repr__(self):
+        return f"Scan({self.table} as {self.alias})"
+
+
+@dataclasses.dataclass
+class InlineTable(Plan):
+    """Literal rows (VALUES) or a pre-materialized engine table."""
+    table: object  # columnar.Table
+    name: str = "values"
+
+
+@dataclasses.dataclass
+class Filter(Plan):
+    child: Plan
+    condition: Expr
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Project(Plan):
+    child: Plan
+    exprs: List[Tuple[str, Expr]]  # (output name, expr)
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Join(Plan):
+    left: Plan
+    right: Plan
+    kind: str  # inner, left, right, full, semi, anti, cross
+    # equi-join key pairs (left expr, right expr); non-equi residual in extra
+    keys: List[Tuple[Expr, Expr]]
+    extra: Optional[Expr] = None
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass
+class Aggregate(Plan):
+    child: Plan
+    group_by: List[Tuple[str, Expr]]  # output name, key expr
+    aggs: List[Tuple[str, Expr]]      # output name, AggExpr (or expr of aggs)
+    # None = plain group-by; otherwise list of index-subsets of group_by
+    # (grouping sets / rollup). Each set produces rows with the excluded
+    # keys NULL, Spark ROLLUP semantics.
+    grouping_sets: Optional[List[List[int]]] = None
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Window(Plan):
+    child: Plan
+    exprs: List[Tuple[str, Expr]]  # output name, WindowExpr
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Sort(Plan):
+    child: Plan
+    keys: List[Tuple[Expr, bool]]  # (expr, ascending)
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Limit(Plan):
+    child: Plan
+    n: int
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Distinct(Plan):
+    child: Plan
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class SetOp(Plan):
+    kind: str  # union, intersect, except
+    left: Plan
+    right: Plan
+    all: bool = False
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass
+class SubqueryAlias(Plan):
+    """Named derived table / CTE reference."""
+    child: Plan
+    alias: str
+    column_aliases: Optional[List[str]] = None
+
+    def children(self):
+        return (self.child,)
+
+
+def plan_string(p: Plan, indent: int = 0) -> str:
+    pad = "  " * indent
+    label = type(p).__name__
+    detail = ""
+    if isinstance(p, Scan):
+        detail = f" {p.table} as {p.alias}" + (
+            f" pred={p.predicate}" if p.predicate is not None else "")
+    elif isinstance(p, Filter):
+        detail = f" {p.condition}"
+    elif isinstance(p, Join):
+        detail = f" {p.kind} on {p.keys}" + (
+            f" extra={p.extra}" if p.extra is not None else "")
+    elif isinstance(p, Aggregate):
+        detail = f" by {[n for n, _ in p.group_by]}"
+        if p.grouping_sets is not None:
+            detail += f" sets={p.grouping_sets}"
+    elif isinstance(p, Project):
+        detail = f" {[n for n, _ in p.exprs]}"
+    elif isinstance(p, Sort):
+        detail = f" {[(str(e), a) for e, a in p.keys]}"
+    elif isinstance(p, Limit):
+        detail = f" {p.n}"
+    elif isinstance(p, SetOp):
+        detail = f" {p.kind}{' all' if p.all else ''}"
+    elif isinstance(p, SubqueryAlias):
+        detail = f" {p.alias}"
+    lines = [f"{pad}{label}{detail}"]
+    for c in p.children():
+        lines.append(plan_string(c, indent + 1))
+    return "\n".join(lines)

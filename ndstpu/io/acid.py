@@ -122,7 +122,8 @@ def create_table(table_dir: str, at: pa.Table,
     os.makedirs(table_dir, exist_ok=True)
     if partition_col is not None:
         at = at.sort_by([(partition_col, "ascending")])
-    snap = Snapshot(0, time.time(), [_new_data_file(table_dir, at)],
+    version = _next_version(table_dir) if is_ndslake(table_dir) else 0
+    snap = Snapshot(version, time.time(), [_new_data_file(table_dir, at)],
                     partition_col, "create")
     _write_snapshot(table_dir, snap)
 

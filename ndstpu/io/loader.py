@@ -110,7 +110,9 @@ def read_warehouse_table(warehouse: str, table: str,
                     parts.append(paorc.read_table(p))
                 elif fmt == "csv":
                     import pyarrow.csv as pacsv
-                    parts.append(pacsv.read_csv(p))
+                    parts.append(pacsv.read_csv(
+                        p, convert_options=pacsv.ConvertOptions(
+                            strings_can_be_null=True)))
                 else:
                     import pandas as pd
                     parts.append(

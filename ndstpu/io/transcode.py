@@ -34,26 +34,25 @@ FACT_PARTITION = nds_schema.TABLE_PARTITIONING
 
 
 def _write_partitioned(at: pa.Table, out_dir: str, part_col: str,
-                       fmt: str, compression: str) -> None:
-    """Date-partitioned write: sort by the partition key, then one file per
-    key directory (hive-style `col=value/`), nulls in `col=__NULL__/`.
+                       compression: str) -> None:
+    """Date-partitioned parquet write: sort by the partition key, then one
+    file per key directory (hive-style `col=value/`), nulls in `col=__NULL__/`.
     Unique basenames make repeated appends additive rather than clobbering."""
     import uuid
 
     import pyarrow.dataset as ds
 
-    sort_keys = [(part_col, "ascending")]
-    at = at.sort_by(sort_keys)
+    at = at.sort_by([(part_col, "ascending")])
     ds.write_dataset(
         at, out_dir,
-        format="parquet" if fmt == "parquet" else fmt,
+        format="parquet",
         partitioning=ds.partitioning(
             pa.schema([at.schema.field(part_col)]), flavor="hive"),
         existing_data_behavior="overwrite_or_ignore",
         basename_template="part-" + uuid.uuid4().hex + "-{i}.parquet",
         max_partitions=4096,  # day-grain partitioning: ~1800+NULL dirs
-        file_options=(ds.ParquetFileFormat().make_write_options(
-            compression=compression) if fmt == "parquet" else None),
+        file_options=ds.ParquetFileFormat().make_write_options(
+            compression=compression),
     )
 
 
@@ -75,7 +74,8 @@ def _write_single(at: pa.Table, out_dir: str, table: str, fmt: str,
         pacsv.write_csv(at, path)
     elif fmt == "json":
         import pandas as pd  # noqa: F401
-        at.to_pandas().to_json(path, orient="records", lines=True)
+        at.to_pandas().to_json(path, orient="records", lines=True,
+                               date_format="iso")
     else:
         raise ValueError(f"unsupported format {fmt}")
 
@@ -103,7 +103,7 @@ def transcode_table(args, table: str, tschema) -> float:
                               partition_col=FACT_PARTITION.get(table))
     elif table in FACT_PARTITION and args.output_format == "parquet":
         _write_partitioned(at, out_root, FACT_PARTITION[table],
-                           args.output_format, args.compression)
+                           args.compression)
     else:
         _write_single(at, out_root, table, args.output_format,
                       args.compression)

@@ -1,0 +1,279 @@
+"""Engine semantics tests: expressions, joins, aggregates, windows, set ops.
+
+Hand-built logical plans over tiny in-memory tables; Spark-compatible
+NULL/decimal/ordering semantics are the acceptance bar (they are what the
+validator assumes, cf. reference nds_validate.py).
+"""
+
+import numpy as np
+import pytest
+
+from ndstpu.engine import columnar, expr as ex, physical, plan as lp
+from ndstpu.engine.columnar import BOOL, FLOAT64, INT32, INT64, Column, Table, decimal
+from ndstpu.io.loader import Catalog
+
+
+def col_i32(vals):
+    valid = np.array([v is not None for v in vals])
+    data = np.array([0 if v is None else v for v in vals], dtype=np.int32)
+    return Column(data, INT32, None if valid.all() else valid)
+
+
+def col_dec(vals, scale=2):
+    valid = np.array([v is not None for v in vals])
+    data = np.array([0 if v is None else round(v * 10**scale) for v in vals],
+                    dtype=np.int64)
+    return Column(data, decimal(7, scale), None if valid.all() else valid)
+
+
+def make_catalog(**tables) -> Catalog:
+    cat = Catalog()
+    for name, t in tables.items():
+        cat.register(name, t)
+    return cat
+
+
+@pytest.fixture
+def sales_cat():
+    sales = Table({
+        "s_item": col_i32([1, 2, 1, 3, 2, None]),
+        "s_qty": col_i32([10, 20, 30, 40, 50, 60]),
+        "s_price": col_dec([1.50, 2.25, 1.00, None, 3.10, 4.00]),
+    })
+    items = Table({
+        "i_item": col_i32([1, 2, 3]),
+        "i_name": Column.from_strings(["apple", "banana", "cherry"]),
+    })
+    return make_catalog(sales=sales, items=items)
+
+
+def run(plan, cat):
+    return physical.execute(plan, cat)
+
+
+# -- expressions -------------------------------------------------------------
+
+def test_three_valued_logic():
+    t = Table({"a": col_i32([1, None, 0])})
+    # a = 1 AND a IS NOT NULL etc.
+    e = ex.BinOp("and",
+                 ex.BinOp("=", ex.ColumnRef("a"), ex.Literal(1)),
+                 ex.Literal(True))
+    mask = ex.eval_predicate(t, e)
+    assert list(mask) == [True, False, False]
+    # NULL OR TRUE == TRUE
+    e2 = ex.BinOp("or",
+                  ex.BinOp("=", ex.ColumnRef("a"), ex.Literal(1)),
+                  ex.Literal(True))
+    c = ex.Evaluator(t).eval(e2)
+    assert list(c.data & c.validity()) == [True, True, True]
+
+
+def test_decimal_arithmetic():
+    t = Table({"p": col_dec([1.50, 2.25]), "q": col_i32([2, 4])})
+    c = ex.Evaluator(t).eval(
+        ex.BinOp("*", ex.ColumnRef("p"), ex.ColumnRef("q")))
+    assert c.ctype.kind == "decimal" and c.ctype.scale == 2
+    assert list(c.data) == [300, 900]
+    c2 = ex.Evaluator(t).eval(
+        ex.BinOp("+", ex.ColumnRef("p"), ex.Literal(1)))
+    assert list(c2.data) == [250, 325]
+
+
+def test_division_null_on_zero():
+    t = Table({"a": col_i32([6, 5]), "b": col_i32([2, 0])})
+    c = ex.Evaluator(t).eval(
+        ex.BinOp("/", ex.ColumnRef("a"), ex.ColumnRef("b")))
+    assert c.to_pylist() == [3.0, None]
+
+
+def test_like_and_substr():
+    t = Table({"s": Column.from_strings(["apple pie", "banana", None])})
+    c = ex.Evaluator(t).eval(
+        ex.Func("like", (ex.ColumnRef("s"), ex.Literal("%pie%"))))
+    assert c.to_pylist() == [True, False, None]
+    c2 = ex.Evaluator(t).eval(
+        ex.Func("substr", (ex.ColumnRef("s"), ex.Literal(1), ex.Literal(3))))
+    assert c2.to_pylist() == ["app", "ban", None]
+
+
+def test_case_expr():
+    t = Table({"a": col_i32([1, 2, 3])})
+    c = ex.Evaluator(t).eval(ex.Case(
+        ((ex.BinOp("=", ex.ColumnRef("a"), ex.Literal(1)), ex.Literal(10)),
+         (ex.BinOp("=", ex.ColumnRef("a"), ex.Literal(2)), ex.Literal(20))),
+        ex.Literal(0)))
+    assert c.to_pylist() == [10, 20, 0]
+
+
+# -- plans -------------------------------------------------------------------
+
+def test_filter_project(sales_cat):
+    p = lp.Project(
+        lp.Filter(lp.Scan("sales", "sales"),
+                  ex.BinOp(">", ex.ColumnRef("s_qty"), ex.Literal(25))),
+        [("q", ex.ColumnRef("s_qty"))])
+    out = run(p, sales_cat)
+    assert out.to_pydict()["q"] == [30, 40, 50, 60]
+
+
+def test_inner_join_null_keys_dont_match(sales_cat):
+    p = lp.Join(lp.Scan("sales", "sales"), lp.Scan("items", "items"),
+                "inner", [(ex.ColumnRef("s_item"), ex.ColumnRef("i_item"))])
+    out = run(p, sales_cat)
+    assert out.num_rows == 5  # NULL item row dropped
+    d = out.to_pydict()
+    for it, nm in zip(d["s_item"], d["i_name"]):
+        assert {1: "apple", 2: "banana", 3: "cherry"}[it] == nm
+
+
+def test_left_join(sales_cat):
+    p = lp.Join(lp.Scan("sales", "sales"), lp.Scan("items", "items"),
+                "left", [(ex.ColumnRef("s_item"), ex.ColumnRef("i_item"))])
+    out = run(p, sales_cat)
+    assert out.num_rows == 6
+    d = out.to_pydict()
+    row = [i for i, v in enumerate(d["s_item"]) if v is None]
+    assert len(row) == 1 and d["i_name"][row[0]] is None
+
+
+def test_semi_anti_join(sales_cat):
+    semi = run(lp.Join(lp.Scan("items", "items"), lp.Scan("sales", "sales"),
+                       "semi",
+                       [(ex.ColumnRef("i_item"), ex.ColumnRef("s_item"))]),
+               sales_cat)
+    assert semi.num_rows == 3
+    anti = run(lp.Join(lp.Scan("items", "items"),
+                       lp.Filter(lp.Scan("sales", "sales"),
+                                 ex.BinOp("<", ex.ColumnRef("s_item"),
+                                          ex.Literal(3))),
+                       "anti",
+                       [(ex.ColumnRef("i_item"), ex.ColumnRef("s_item"))]),
+               sales_cat)
+    assert anti.to_pydict()["i_item"] == [3]
+
+
+def test_many_to_many_join():
+    l = Table({"k": col_i32([1, 1, 2])})
+    r = Table({"k2": col_i32([1, 1, 1, 2]), "v": col_i32([7, 8, 9, 5])})
+    cat = make_catalog(l=l, r=r)
+    out = run(lp.Join(lp.Scan("l", "l"), lp.Scan("r", "r"), "inner",
+                      [(ex.ColumnRef("k"), ex.ColumnRef("k2"))]), cat)
+    assert out.num_rows == 7  # 3 + 3 + 1
+
+
+def test_group_by_aggregates(sales_cat):
+    p = lp.Aggregate(
+        lp.Scan("sales", "sales"),
+        [("item", ex.ColumnRef("s_item"))],
+        [("total_qty", ex.AggExpr("sum", ex.ColumnRef("s_qty"))),
+         ("n", ex.AggExpr("count", ex.Star())),
+         ("avg_price", ex.AggExpr("avg", ex.ColumnRef("s_price"))),
+         ("max_q", ex.AggExpr("max", ex.ColumnRef("s_qty")))])
+    out = run(lp.Sort(p, [(ex.ColumnRef("item"), True)]), sales_cat)
+    d = out.to_pydict()
+    # null group sorts first (Spark ASC NULLS FIRST)
+    assert d["item"] == [None, 1, 2, 3]
+    assert d["total_qty"] == [60, 40, 70, 40]
+    assert d["n"] == [1, 2, 2, 1]
+    assert d["avg_price"][1] == pytest.approx(1.25)
+    assert d["avg_price"][3] is None  # only NULL prices in group 3
+    assert d["max_q"] == [60, 30, 50, 40]
+
+
+def test_sum_decimal_exact(sales_cat):
+    p = lp.Aggregate(lp.Scan("sales", "sales"), [],
+                     [("s", ex.AggExpr("sum", ex.ColumnRef("s_price")))])
+    out = run(p, sales_cat)
+    assert out.to_pydict()["s"] == [pytest.approx(11.85)]
+
+
+def test_rollup(sales_cat):
+    p = lp.Aggregate(
+        lp.Filter(lp.Scan("sales", "sales"),
+                  ex.UnaryOp("isnotnull", ex.ColumnRef("s_item"))),
+        [("item", ex.ColumnRef("s_item"))],
+        [("q", ex.AggExpr("sum", ex.ColumnRef("s_qty")))],
+        grouping_sets=[[0], []])
+    out = run(lp.Sort(p, [(ex.ColumnRef("item"), True)]), sales_cat)
+    d = out.to_pydict()
+    assert d["item"] == [None, 1, 2, 3]
+    assert d["q"] == [150, 40, 70, 40]  # grand total row has NULL key
+
+
+def test_count_distinct():
+    t = Table({"g": col_i32([1, 1, 1, 2, 2]),
+               "v": col_i32([5, 5, 7, 5, None])})
+    cat = make_catalog(t=t)
+    p = lp.Aggregate(lp.Scan("t", "t"), [("g", ex.ColumnRef("g"))],
+                     [("cd", ex.AggExpr("count", ex.ColumnRef("v"),
+                                        distinct=True))])
+    out = run(lp.Sort(p, [(ex.ColumnRef("g"), True)]), cat)
+    assert out.to_pydict()["cd"] == [2, 1]
+
+
+def test_distinct_and_setops():
+    a = Table({"x": col_i32([1, 2, 2, 3])})
+    b = Table({"y": col_i32([2, 3, 4])})
+    cat = make_catalog(a=a, b=b)
+    d = run(lp.Distinct(lp.Scan("a", "a")), cat)
+    assert sorted(d.to_pydict()["x"]) == [1, 2, 3]
+    u = run(lp.SetOp("union", lp.Scan("a", "a"), lp.Scan("b", "b")), cat)
+    assert sorted(u.to_pydict()["x"]) == [1, 2, 3, 4]
+    i = run(lp.SetOp("intersect", lp.Scan("a", "a"), lp.Scan("b", "b")), cat)
+    assert sorted(i.to_pydict()["x"]) == [2, 3]
+    e = run(lp.SetOp("except", lp.Scan("a", "a"), lp.Scan("b", "b")), cat)
+    assert sorted(e.to_pydict()["x"]) == [1]
+
+
+def test_sort_order_nulls_and_desc(sales_cat):
+    p = lp.Sort(lp.Scan("sales", "sales"),
+                [(ex.ColumnRef("s_item"), True),
+                 (ex.ColumnRef("s_qty"), False)])
+    out = run(p, sales_cat)
+    d = out.to_pydict()
+    assert d["s_item"] == [None, 1, 1, 2, 2, 3]
+    assert d["s_qty"][:3] == [60, 30, 10]  # qty desc within item
+
+
+def test_limit(sales_cat):
+    p = lp.Limit(lp.Sort(lp.Scan("sales", "sales"),
+                         [(ex.ColumnRef("s_qty"), False)]), 2)
+    out = run(p, sales_cat)
+    assert out.to_pydict()["s_qty"] == [60, 50]
+
+
+def test_window_rank():
+    t = Table({"g": col_i32([1, 1, 1, 2, 2]),
+               "v": col_i32([10, 20, 20, 5, 1])})
+    cat = make_catalog(t=t)
+    w = ex.WindowExpr("rank", None, (ex.ColumnRef("g"),),
+                      ((ex.ColumnRef("v"), False),))
+    out = run(lp.Window(lp.Scan("t", "t"), [("r", w)]), cat)
+    d = out.to_pydict()
+    assert d["r"] == [3, 1, 1, 1, 2]
+    w2 = ex.WindowExpr("dense_rank", None, (ex.ColumnRef("g"),),
+                       ((ex.ColumnRef("v"), False),))
+    out2 = run(lp.Window(lp.Scan("t", "t"), [("r", w2)]), cat)
+    assert out2.to_pydict()["r"] == [2, 1, 1, 1, 2]
+
+
+def test_window_partition_sum():
+    t = Table({"g": col_i32([1, 1, 2]), "v": col_dec([1.00, 2.00, 5.00])})
+    cat = make_catalog(t=t)
+    w = ex.WindowExpr("sum", ex.ColumnRef("v"), (ex.ColumnRef("g"),), ())
+    out = run(lp.Window(lp.Scan("t", "t"), [("s", w)]), cat)
+    assert out.to_pydict()["s"] == [3.0, 3.0, 5.0]
+
+
+def test_full_join():
+    l = Table({"k": col_i32([1, 2]), "a": col_i32([10, 20])})
+    r = Table({"k2": col_i32([2, 3]), "b": col_i32([200, 300])})
+    cat = make_catalog(l=l, r=r)
+    out = run(lp.Join(lp.Scan("l", "l"), lp.Scan("r", "r"), "full",
+                      [(ex.ColumnRef("k"), ex.ColumnRef("k2"))]), cat)
+    rows = sorted(out.to_rows(), key=lambda x: (x[0] is None, x[0] or 0))
+    assert len(rows) == 3
+    assert rows[0] == (1, 10, None, None)
+    assert rows[1] == (2, 20, 2, 200)
+    assert rows[2] == (None, None, 3, 300)
