@@ -1,0 +1,397 @@
+"""Logical plan optimizer.
+
+Round-1 rule set (the ones that dominate NDS star-join performance):
+
+1. predicate pushdown — through rename-Projects, split across Join sides,
+   finally merged into Scan.predicate (evaluated on the raw table before
+   anything else touches it; the TPU path also uses it for partition
+   pruning on date_sk).
+2. projection pruning — each operator keeps only columns its ancestors
+   need; Scans record the narrowed column list (Scan.columns).
+
+Both operate on the planner's invariant that all non-generated column names
+are globally unique ("alias.col"), which makes substitution trivial.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ndstpu.engine import expr as ex, plan as lp
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+def _conjuncts(e: Optional[ex.Expr]) -> List[ex.Expr]:
+    if e is None:
+        return []
+    if isinstance(e, ex.BinOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _conjoin(parts) -> Optional[ex.Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else ex.BinOp("and", out, p)
+    return out
+
+
+def _refs(e: ex.Expr) -> Set[str]:
+    return {n.name for n in e.walk() if isinstance(n, ex.ColumnRef)}
+
+
+def _substitute(e: ex.Expr, mapping: Dict[str, ex.Expr]) -> ex.Expr:
+    if isinstance(e, ex.ColumnRef):
+        return mapping.get(e.name, e)
+    if isinstance(e, ex.BinOp):
+        return ex.BinOp(e.op, _substitute(e.left, mapping),
+                        _substitute(e.right, mapping))
+    if isinstance(e, ex.UnaryOp):
+        return ex.UnaryOp(e.op, _substitute(e.operand, mapping))
+    if isinstance(e, ex.Cast):
+        return ex.Cast(_substitute(e.operand, mapping), e.target)
+    if isinstance(e, ex.Func):
+        return ex.Func(e.name, tuple(_substitute(a, mapping) for a in e.args))
+    if isinstance(e, ex.InList):
+        return ex.InList(_substitute(e.operand, mapping), e.values, e.negated)
+    if isinstance(e, ex.Case):
+        return ex.Case(tuple((_substitute(c, mapping), _substitute(v, mapping))
+                             for c, v in e.whens),
+                       _substitute(e.default, mapping)
+                       if e.default is not None else None)
+    if isinstance(e, ex.AggExpr):
+        if isinstance(e.arg, ex.Star):
+            return e
+        return ex.AggExpr(e.func, _substitute(e.arg, mapping), e.distinct)
+    if isinstance(e, ex.WindowExpr):
+        return ex.WindowExpr(
+            e.func,
+            None if e.arg is None or isinstance(e.arg, ex.Star)
+            else _substitute(e.arg, mapping),
+            tuple(_substitute(p, mapping) for p in e.partition_by),
+            tuple((_substitute(o, mapping), a) for o, a in e.order_by))
+    return e
+
+
+def _output_names(p: lp.Plan) -> List[str]:
+    if isinstance(p, lp.Project):
+        return [n for n, _ in p.exprs]
+    if isinstance(p, lp.Aggregate):
+        return [n for n, _ in p.group_by] + [n for n, _ in p.aggs]
+    if isinstance(p, (lp.Filter, lp.Sort, lp.Limit, lp.Distinct)):
+        return _output_names(p.child)
+    if isinstance(p, lp.SetOp):
+        return _output_names(p.left)
+    if isinstance(p, lp.InlineTable):
+        return list(p.table.column_names)
+    if isinstance(p, lp.Window):
+        return _output_names(p.child) + [n for n, _ in p.exprs]
+    if isinstance(p, lp.Join):
+        return _output_names(p.left) + _output_names(p.right)
+    if isinstance(p, lp.Scan):
+        raise RuntimeError("bare Scan in optimizer (planner wraps in Project)")
+    if isinstance(p, lp.SubqueryAlias):
+        return _output_names(p.child)
+    raise RuntimeError(f"output names of {type(p).__name__}")
+
+
+# -- predicate pushdown ------------------------------------------------------
+
+
+def push_filters(p: lp.Plan) -> lp.Plan:
+    if isinstance(p, lp.Filter):
+        child = push_filters(p.child)
+        conjs = _conjuncts(p.condition)
+        return _push_conjuncts(child, conjs)
+    for attr in ("child", "left", "right"):
+        if hasattr(p, attr):
+            setattr(p, attr, push_filters(getattr(p, attr)))
+    return p
+
+
+def _push_conjuncts(p: lp.Plan, conjs: List[ex.Expr]) -> lp.Plan:
+    if not conjs:
+        return p
+    if isinstance(p, lp.Project):
+        # only push through pure-rename/deterministic projections
+        mapping = {n: e for n, e in p.exprs}
+        pushable, stay = [], []
+        for c in conjs:
+            if all(r in mapping and not isinstance(
+                    mapping[r], (ex.AggExpr, ex.WindowExpr))
+                   for r in _refs(c)) and not _has_subquery(c):
+                pushable.append(_substitute(c, mapping))
+            else:
+                stay.append(c)
+        if pushable:
+            p.child = _push_conjuncts(p.child, pushable)
+        return lp.Filter(p, _conjoin(stay)) if stay else p
+    if isinstance(p, lp.Join):
+        lcols = set(_output_names(p.left))
+        rcols = set(_output_names(p.right))
+        lpush, rpush, stay = [], [], []
+        for c in conjs:
+            refs = _refs(c)
+            # turn cross/inner joins + cross-side equality into equi-joins —
+            # this is what makes comma-join star queries feasible
+            if p.kind in ("cross", "inner") and \
+                    isinstance(c, ex.BinOp) and c.op == "=":
+                lr = _refs(c.left)
+                rr = _refs(c.right)
+                if lr and rr:
+                    if lr <= lcols and rr <= rcols:
+                        p.keys.append((c.left, c.right))
+                        p.kind = "inner"
+                        continue
+                    if lr <= rcols and rr <= lcols:
+                        p.keys.append((c.right, c.left))
+                        p.kind = "inner"
+                        continue
+            if refs <= lcols and p.kind in ("inner", "left", "semi", "anti",
+                                            "nullaware_anti", "cross"):
+                lpush.append(c)
+            elif refs <= rcols and p.kind in ("inner", "cross"):
+                rpush.append(c)
+            else:
+                stay.append(c)
+        if lpush:
+            p.left = _push_conjuncts(p.left, lpush)
+        if rpush:
+            p.right = _push_conjuncts(p.right, rpush)
+        return lp.Filter(p, _conjoin(stay)) if stay else p
+    if isinstance(p, lp.Filter):
+        return _push_conjuncts(p.child, conjs + _conjuncts(p.condition))
+    if isinstance(p, lp.Scan):
+        existing = _conjuncts(p.predicate)
+        p.predicate = _conjoin(existing + conjs)
+        return p
+    if isinstance(p, (lp.Sort, lp.Limit)):
+        # pushing past Limit changes semantics; past Sort is fine
+        if isinstance(p, lp.Sort):
+            p.child = _push_conjuncts(p.child, conjs)
+            return p
+        return lp.Filter(p, _conjoin(conjs))
+    if isinstance(p, lp.Distinct):
+        p.child = _push_conjuncts(p.child, conjs)
+        return p
+    return lp.Filter(p, _conjoin(conjs))
+
+
+def _has_subquery(e: ex.Expr) -> bool:
+    return any(isinstance(x, ex.SubqueryExpr) for x in e.walk())
+
+
+# -- projection pruning ------------------------------------------------------
+
+
+def prune(p: lp.Plan, needed: Optional[Set[str]] = None) -> lp.Plan:
+    """Drop unused columns; `needed` = columns the parent requires
+    (None = keep all outputs)."""
+    if isinstance(p, lp.Project):
+        if needed is not None:
+            kept = [(n, e) for n, e in p.exprs if n in needed]
+            if not kept and p.exprs:
+                # keep one column as the row-count carrier (count(*) case)
+                kept = [p.exprs[0]]
+            p.exprs = kept
+        child_needed: Set[str] = set()
+        for _n, e in p.exprs:
+            child_needed |= _refs(e)
+        p.child = prune(p.child, child_needed)
+        return p
+    if isinstance(p, lp.Scan):
+        if needed is not None:
+            cols = set(needed)
+            if p.predicate is not None:
+                cols |= _refs(p.predicate)
+            p.columns = sorted(cols)
+        return p
+    if isinstance(p, lp.Filter):
+        child_needed = None if needed is None else \
+            set(needed) | _refs(p.condition)
+        p.child = prune(p.child, child_needed)
+        return p
+    if isinstance(p, lp.Join):
+        if needed is None:
+            p.left = prune(p.left, None)
+            p.right = prune(p.right, None)
+            return p
+        child_needed = set(needed)
+        for le, re_ in p.keys:
+            child_needed |= _refs(le) | _refs(re_)
+        if p.extra is not None:
+            child_needed |= _refs(p.extra)
+        lcols = set(_output_names(p.left))
+        rcols = set(_output_names(p.right))
+        p.left = prune(p.left, child_needed & lcols)
+        p.right = prune(p.right, child_needed & rcols)
+        return p
+    if isinstance(p, lp.Aggregate):
+        child_needed = set()
+        for _n, e in p.group_by:
+            child_needed |= _refs(e)
+        for _n, e in p.aggs:
+            child_needed |= _refs(e)
+        p.child = prune(p.child, child_needed)
+        return p
+    if isinstance(p, lp.Window):
+        child_needed = None if needed is None else set(needed)
+        if child_needed is not None:
+            for _n, e in p.exprs:
+                child_needed |= _refs(e)
+            child_needed &= set(_output_names(p.child))
+        p.child = prune(p.child, child_needed)
+        return p
+    if isinstance(p, lp.Sort):
+        child_needed = None if needed is None else set(needed)
+        if child_needed is not None:
+            for entry in p.keys:
+                child_needed |= _refs(entry[0])
+        p.child = prune(p.child, child_needed)
+        return p
+    if isinstance(p, (lp.Limit, lp.Distinct)):
+        p.child = prune(p.child, needed if not isinstance(p, lp.Distinct)
+                        else None)
+        return p
+    if isinstance(p, lp.SetOp):
+        # set ops compare whole rows: keep all columns
+        p.left = prune(p.left, None)
+        p.right = prune(p.right, None)
+        return p
+    if isinstance(p, lp.SubqueryAlias):
+        p.child = prune(p.child, needed)
+        return p
+    return p
+
+
+# -- join reordering ---------------------------------------------------------
+
+
+def _estimate_rows(p: lp.Plan, catalog) -> float:
+    """Crude cardinality estimate for join ordering (no stats yet):
+    base table rows, decimated by pushed predicates."""
+    if isinstance(p, lp.Scan):
+        n = float(catalog.get(p.table).num_rows) if catalog is not None \
+            and p.table in catalog else 1e6
+        return max(n / 20.0, 1.0) if p.predicate is not None else n
+    if isinstance(p, lp.Project):
+        return _estimate_rows(p.child, catalog)
+    if isinstance(p, lp.Filter):
+        return max(_estimate_rows(p.child, catalog) / 20.0, 1.0)
+    if isinstance(p, (lp.Sort, lp.Distinct, lp.Window)):
+        return _estimate_rows(p.child, catalog)
+    if isinstance(p, lp.Limit):
+        return min(float(p.n), _estimate_rows(p.child, catalog))
+    if isinstance(p, lp.Aggregate):
+        return max(_estimate_rows(p.child, catalog) / 100.0, 1.0)
+    if isinstance(p, lp.Join):
+        l = _estimate_rows(p.left, catalog)
+        r = _estimate_rows(p.right, catalog)
+        if p.kind in ("semi", "anti", "nullaware_anti"):
+            return l
+        return max(l, r)
+    if isinstance(p, lp.InlineTable):
+        return float(p.table.num_rows)
+    if isinstance(p, lp.SetOp):
+        return _estimate_rows(p.left, catalog) + \
+            _estimate_rows(p.right, catalog)
+    return 1e6
+
+
+def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
+    """Flatten chains of inner/cross joins and rebuild greedily: start from
+    the largest relation (the fact table), then repeatedly join the smallest
+    key-connected relation — TPC-DS star/snowflake shapes resolve to
+    fact-with-filtered-dims pipelines with no accidental cross joins."""
+    for attr in ("child", "left", "right"):
+        if hasattr(p, attr):
+            setattr(p, attr, reorder_joins(getattr(p, attr), catalog))
+    if not (isinstance(p, lp.Join) and p.kind in ("inner", "cross")):
+        return p
+
+    leaves: List[lp.Plan] = []
+    keys: List[Tuple[ex.Expr, ex.Expr]] = []
+    extras: List[ex.Expr] = []
+
+    def flatten(n: lp.Plan):
+        if isinstance(n, lp.Join) and n.kind in ("inner", "cross"):
+            flatten(n.left)
+            flatten(n.right)
+            keys.extend(n.keys)
+            if n.extra is not None:
+                extras.append(n.extra)
+        else:
+            leaves.append(n)
+
+    flatten(p)
+    if len(leaves) <= 2:
+        return p
+
+    cols: List[Set[str]] = [set(_output_names(l)) for l in leaves]
+    sizes = [_estimate_rows(l, catalog) for l in leaves]
+
+    def leaf_of(refs: Set[str]) -> Optional[int]:
+        for i, cs in enumerate(cols):
+            if refs <= cs:
+                return i
+        return None
+
+    # key edges between leaves
+    edges = []  # (li, ri, left_expr, right_expr) with li side expr first
+    residual_keys = []
+    for le, re_ in keys:
+        li = leaf_of(_refs(le))
+        ri = leaf_of(_refs(re_))
+        if li is None or ri is None or li == ri:
+            residual_keys.append((le, re_))
+            continue
+        edges.append((li, ri, le, re_))
+
+    start = max(range(len(leaves)), key=lambda i: sizes[i])
+    joined = {start}
+    current: lp.Plan = leaves[start]
+    current_cols = set(cols[start])
+    remaining = set(range(len(leaves))) - joined
+    used = [False] * len(edges)
+
+    while remaining:
+        # candidates connected to the joined set
+        cand: Dict[int, List[int]] = {}
+        for k, (li, ri, _le, _re) in enumerate(edges):
+            if used[k]:
+                continue
+            if li in joined and ri in remaining:
+                cand.setdefault(ri, []).append(k)
+            elif ri in joined and li in remaining:
+                cand.setdefault(li, []).append(k)
+        if cand:
+            nxt = min(cand, key=lambda i: sizes[i])
+            pair_keys = []
+            for k in cand[nxt]:
+                li, ri, le, re_ = edges[k]
+                used[k] = True
+                if li in joined:
+                    pair_keys.append((le, re_))
+                else:
+                    pair_keys.append((re_, le))
+            current = lp.Join(current, leaves[nxt], "inner", pair_keys)
+        else:
+            nxt = min(remaining, key=lambda i: sizes[i])
+            current = lp.Join(current, leaves[nxt], "cross", [])
+        joined.add(nxt)
+        remaining.discard(nxt)
+        current_cols |= cols[nxt]
+
+    # keys that span >2 leaves or got orphaned become residual filters
+    conds = [ex.BinOp("=", le, re_) for le, re_ in residual_keys] + extras
+    cond = _conjoin(conds)
+    return lp.Filter(current, cond) if cond is not None else current
+
+
+def optimize(p: lp.Plan, catalog=None) -> lp.Plan:
+    p = push_filters(p)
+    p = reorder_joins(p, catalog)
+    p = prune(p, None)
+    return p

@@ -36,6 +36,7 @@ from ndstpu.engine.columnar import (
 class Executor:
     def __init__(self, catalog):
         self.catalog = catalog
+        self._subq_cache: Dict[int, ex.Expr] = {}
 
     # -- entry ---------------------------------------------------------------
 
@@ -48,9 +49,11 @@ class Executor:
     def _exec_scan(self, p: lp.Scan) -> Table:
         t = self.catalog.get(p.table)
         if p.predicate is not None:
-            t = t.filter(ex.eval_predicate(t, p.predicate))
+            t = t.filter(ex.eval_predicate(
+                t, self._resolve_subqueries(p.predicate)))
         if p.columns is not None:
-            t = t.select([c for c in p.columns])
+            cols = list(p.columns) or t.column_names[:1]  # row-count carrier
+            t = t.select(cols)
         return t
 
     def _exec_inlinetable(self, p: lp.InlineTable) -> Table:
@@ -62,16 +65,71 @@ class Executor:
             t = Table(dict(zip(p.column_aliases, t.columns.values())))
         return t
 
+    # -- subquery resolution -------------------------------------------------
+
+    def _resolve_subqueries(self, e: ex.Expr) -> ex.Expr:
+        """Execute uncorrelated scalar/IN subqueries once and inline the
+        result (the planner leaves them as SubqueryExpr leaves)."""
+        if isinstance(e, ex.SubqueryExpr):
+            if id(e) in self._subq_cache:
+                return self._subq_cache[id(e)]
+            resolved = self._resolve_subquery_once(e)
+            self._subq_cache[id(e)] = resolved
+            return resolved
+        if isinstance(e, ex.BinOp):
+            return ex.BinOp(e.op, self._resolve_subqueries(e.left),
+                            self._resolve_subqueries(e.right))
+        if isinstance(e, ex.UnaryOp):
+            return ex.UnaryOp(e.op, self._resolve_subqueries(e.operand))
+        if isinstance(e, ex.Cast):
+            return ex.Cast(self._resolve_subqueries(e.operand), e.target)
+        if isinstance(e, ex.Func):
+            return ex.Func(e.name, tuple(self._resolve_subqueries(a)
+                                         for a in e.args))
+        if isinstance(e, ex.Case):
+            return ex.Case(
+                tuple((self._resolve_subqueries(c),
+                       self._resolve_subqueries(v)) for c, v in e.whens),
+                self._resolve_subqueries(e.default)
+                if e.default is not None else None)
+        if isinstance(e, ex.InList):
+            return ex.InList(self._resolve_subqueries(e.operand), e.values,
+                             e.negated)
+        return e
+
+    def _resolve_subquery_once(self, e: ex.SubqueryExpr) -> ex.Expr:
+        t = self.execute(e.plan)
+        col = t.columns[t.column_names[0]]
+        if e.kind == "scalar":
+            if t.num_rows == 0:
+                return ex.Literal(None, col.ctype)
+            vals = col.to_pylist()
+            if len(vals) > 1:
+                raise RuntimeError("scalar subquery returned >1 row")
+            return ex.Literal(vals[0], col.ctype)
+        if e.kind == "in":
+            pyvals = col.to_pylist()
+            has_null = any(v is None for v in pyvals)
+            vals = tuple(v for v in pyvals if v is not None)
+            if e.negated and has_null:
+                # SQL 3VL: x NOT IN (..., NULL) is never TRUE
+                return ex.Literal(False)
+            return ex.InList(self._resolve_subqueries(e.operand), vals,
+                             e.negated)
+        raise NotImplementedError(f"subquery kind {e.kind}")
+
     # -- row ops -------------------------------------------------------------
 
     def _exec_filter(self, p: lp.Filter) -> Table:
         t = self.execute(p.child)
-        return t.filter(ex.eval_predicate(t, p.condition))
+        return t.filter(ex.eval_predicate(
+            t, self._resolve_subqueries(p.condition)))
 
     def _exec_project(self, p: lp.Project) -> Table:
         t = self.execute(p.child)
         ev = ex.Evaluator(t)
-        return Table({name: ev.eval(e) for name, e in p.exprs})
+        return Table({name: ev.eval(self._resolve_subqueries(e))
+                      for name, e in p.exprs})
 
     def _exec_limit(self, p: lp.Limit) -> Table:
         return self.execute(p.child).head(p.n)
@@ -136,6 +194,17 @@ class Executor:
             # non-equi outer joins: fall back to per-kind handling below
             raise NotImplementedError(f"non-equi {kind} join")
         lkey, rkey, lvalid, rvalid = self._composite_keys(lt, rt, p.keys)
+        if kind == "nullaware_anti":
+            # NOT IN semantics: any NULL on the subquery side -> no row can
+            # satisfy NOT IN; a NULL probe value never qualifies either.
+            if bool((~rvalid).any()):
+                return lt.filter(np.zeros(lt.num_rows, dtype=bool))
+            kind = "anti"
+            # a NULL probe must NOT survive the anti join (it would under
+            # plain anti semantics, since null keys never match)
+            lt = lt.filter(lvalid)
+            lkey = lkey[lvalid]
+            lvalid = np.ones(len(lkey), dtype=bool)
         # null keys never match
         lkey = np.where(lvalid, lkey, -1)
         rkey = np.where(rvalid, rkey, -2)
@@ -545,12 +614,9 @@ class Executor:
             pid, _ = self._factorize(pcols)
         else:
             pid = np.zeros(n, dtype=np.int64)
-        sort_arrays = [pid]
-        for e, asc in reversed(list(w.order_by)):
-            c = ev.eval(e)
-            key = self._order_key(c, asc)
-            sort_arrays.insert(0, key)
-        order = np.lexsort(sort_arrays[::-1]) if n else np.zeros(0, np.int64)
+        okeys = [self._order_key(ev.eval(e), asc) for e, asc in w.order_by]
+        # lexsort: LAST key is primary -> (reversed order keys, then pid)
+        order = np.lexsort(okeys[::-1] + [pid]) if n else np.zeros(0, np.int64)
         inv = np.empty(n, dtype=np.int64)
         inv[order] = np.arange(n)
         pid_s = pid[order]
@@ -562,7 +628,7 @@ class Executor:
         if w.func == "row_number":
             return Column((pos_in_part + 1)[inv].astype(np.int64), INT64)
         if w.func in ("rank", "dense_rank"):
-            okeys = [a[order] for a in sort_arrays[:-1]]
+            okeys = [a[order] for a in okeys]
             tie = np.zeros(n, dtype=bool)
             if n > 1:
                 tie[1:] = np.ones(n - 1, dtype=bool)
@@ -624,18 +690,21 @@ class Executor:
 
     # -- sort ----------------------------------------------------------------
 
-    def _order_key(self, c: Column, asc: bool) -> np.ndarray:
-        """Sortable int64/float key with Spark null ordering:
-        ASC -> NULLS FIRST, DESC -> NULLS LAST (both = nulls smallest)."""
+    def _order_key(self, c: Column, asc: bool,
+                   nulls_first: Optional[bool] = None) -> np.ndarray:
+        """Sortable key array.  Spark default null ordering: ASC -> NULLS
+        FIRST, DESC -> NULLS LAST; explicit NULLS FIRST/LAST overrides."""
+        if nulls_first is None:
+            nulls_first = asc
+        v = c.validity()
         if c.ctype.kind == "float64":
             data = c.data.astype(np.float64)
-            v = c.validity()
-            data = np.where(v, data, -np.inf)
-            return data if asc else -data
+            key = data if asc else -data
+            return np.where(v, key, -np.inf if nulls_first else np.inf)
         data = c.data.astype(np.int64)
-        v = c.validity()
-        data = np.where(v, data, np.int64(-2**62))
-        return data if asc else -data
+        key = data if asc else -data
+        return np.where(v, key,
+                        np.int64(-2**62) if nulls_first else np.int64(2**62))
 
     def _exec_sort(self, p: lp.Sort) -> Table:
         t = self.execute(p.child)
@@ -643,8 +712,10 @@ class Executor:
             return t
         ev = ex.Evaluator(t)
         keys = []
-        for e, asc in p.keys:
-            keys.append(self._order_key(ev.eval(e), asc))
+        for entry in p.keys:
+            e, asc = entry[0], entry[1]
+            nf = entry[2] if len(entry) > 2 else None
+            keys.append(self._order_key(ev.eval(e), asc, nf))
         order = np.lexsort(keys[::-1])
         return t.gather(order)
 

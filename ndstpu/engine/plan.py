@@ -145,6 +145,46 @@ class SubqueryAlias(Plan):
         return (self.child,)
 
 
+def copy_plan(p: Plan) -> Plan:
+    """Deep-copy the plan tree (expressions are immutable and shared).
+
+    Required wherever one stored plan (view/CTE) is instantiated more than
+    once: the optimizer mutates nodes in place (Scan.predicate/columns,
+    Project.exprs, Aggregate lists), so each reference needs its own nodes."""
+    if isinstance(p, Scan):
+        return Scan(p.table, p.alias,
+                    None if p.columns is None else list(p.columns),
+                    p.predicate)
+    if isinstance(p, InlineTable):
+        return InlineTable(p.table, p.name)
+    if isinstance(p, Filter):
+        return Filter(copy_plan(p.child), p.condition)
+    if isinstance(p, Project):
+        return Project(copy_plan(p.child), list(p.exprs))
+    if isinstance(p, Join):
+        return Join(copy_plan(p.left), copy_plan(p.right), p.kind,
+                    list(p.keys), p.extra)
+    if isinstance(p, Aggregate):
+        return Aggregate(copy_plan(p.child), list(p.group_by), list(p.aggs),
+                         None if p.grouping_sets is None
+                         else [list(s) for s in p.grouping_sets])
+    if isinstance(p, Window):
+        return Window(copy_plan(p.child), list(p.exprs))
+    if isinstance(p, Sort):
+        return Sort(copy_plan(p.child), list(p.keys))
+    if isinstance(p, Limit):
+        return Limit(copy_plan(p.child), p.n)
+    if isinstance(p, Distinct):
+        return Distinct(copy_plan(p.child))
+    if isinstance(p, SetOp):
+        return SetOp(p.kind, copy_plan(p.left), copy_plan(p.right), p.all)
+    if isinstance(p, SubqueryAlias):
+        return SubqueryAlias(copy_plan(p.child), p.alias,
+                             None if p.column_aliases is None
+                             else list(p.column_aliases))
+    raise TypeError(f"copy_plan: {type(p).__name__}")
+
+
 def plan_string(p: Plan, indent: int = 0) -> str:
     pad = "  " * indent
     label = type(p).__name__
@@ -164,7 +204,7 @@ def plan_string(p: Plan, indent: int = 0) -> str:
     elif isinstance(p, Project):
         detail = f" {[n for n, _ in p.exprs]}"
     elif isinstance(p, Sort):
-        detail = f" {[(str(e), a) for e, a in p.keys]}"
+        detail = f" {[(str(k[0]), k[1]) for k in p.keys]}"
     elif isinstance(p, Limit):
         detail = f" {p.n}"
     elif isinstance(p, SetOp):

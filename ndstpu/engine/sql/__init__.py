@@ -1,0 +1,4 @@
+"""SQL frontend: lexer, AST, recursive-descent parser (Spark SQL dialect
+subset covering the NDS query corpus and data-maintenance statements)."""
+
+from ndstpu.engine.sql.parser import parse_statement, parse_statements  # noqa: F401
