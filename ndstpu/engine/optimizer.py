@@ -100,10 +100,51 @@ def _output_names(p: lp.Plan) -> List[str]:
 # -- predicate pushdown ------------------------------------------------------
 
 
+def _factor_common(e: ex.Expr) -> ex.Expr:
+    """Factor conjuncts common to every branch of a disjunction:
+    (E and A) or (E and B)  ->  E and (A or B).
+
+    The TPC-DS demographic-OR pattern (q13/q48/q85) repeats the join
+    equalities inside each OR branch; factoring them out lets the join
+    extraction below find the equi keys instead of cross-joining."""
+    if isinstance(e, ex.BinOp) and e.op == "and":
+        return ex.BinOp("and", _factor_common(e.left),
+                        _factor_common(e.right))
+    if not (isinstance(e, ex.BinOp) and e.op == "or"):
+        return e
+    branches: List[ex.Expr] = []
+
+    def disjuncts(x: ex.Expr):
+        if isinstance(x, ex.BinOp) and x.op == "or":
+            disjuncts(x.left)
+            disjuncts(x.right)
+        else:
+            branches.append(x)
+
+    disjuncts(e)
+    branch_conjs = [_conjuncts(b) for b in branches]
+    common_repr = set(repr(c) for c in branch_conjs[0])
+    for bc in branch_conjs[1:]:
+        common_repr &= {repr(c) for c in bc}
+    if not common_repr:
+        return e
+    common = [c for c in branch_conjs[0] if repr(c) in common_repr]
+    residuals = []
+    for bc in branch_conjs:
+        rest = [c for c in bc if repr(c) not in common_repr]
+        residuals.append(_conjoin(rest))
+    if any(r is None for r in residuals):
+        return _conjoin(common)  # some branch is exactly the common part
+    disj = residuals[0]
+    for r in residuals[1:]:
+        disj = ex.BinOp("or", disj, r)
+    return _conjoin(common + [disj])
+
+
 def push_filters(p: lp.Plan) -> lp.Plan:
     if isinstance(p, lp.Filter):
         child = push_filters(p.child)
-        conjs = _conjuncts(p.condition)
+        conjs = _conjuncts(_factor_common(p.condition))
         return _push_conjuncts(child, conjs)
     for attr in ("child", "left", "right"):
         if hasattr(p, attr):
@@ -352,7 +393,6 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
     start = max(range(len(leaves)), key=lambda i: sizes[i])
     joined = {start}
     current: lp.Plan = leaves[start]
-    current_cols = set(cols[start])
     remaining = set(range(len(leaves))) - joined
     used = [False] * len(edges)
 
@@ -382,7 +422,6 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
             current = lp.Join(current, leaves[nxt], "cross", [])
         joined.add(nxt)
         remaining.discard(nxt)
-        current_cols |= cols[nxt]
 
     # keys that span >2 leaves or got orphaned become residual filters
     conds = [ex.BinOp("=", le, re_) for le, re_ in residual_keys] + extras

@@ -196,15 +196,16 @@ class Executor:
         lkey, rkey, lvalid, rvalid = self._composite_keys(lt, rt, p.keys)
         if kind == "nullaware_anti":
             # NOT IN semantics: any NULL on the subquery side -> no row can
-            # satisfy NOT IN; a NULL probe value never qualifies either.
+            # satisfy NOT IN; a NULL probe value never qualifies either —
+            # unless the subquery is EMPTY, where NOT IN is vacuously TRUE
+            # for every probe including NULL.
             if bool((~rvalid).any()):
                 return lt.filter(np.zeros(lt.num_rows, dtype=bool))
             kind = "anti"
-            # a NULL probe must NOT survive the anti join (it would under
-            # plain anti semantics, since null keys never match)
-            lt = lt.filter(lvalid)
-            lkey = lkey[lvalid]
-            lvalid = np.ones(len(lkey), dtype=bool)
+            if rt.num_rows > 0:
+                lt = lt.filter(lvalid)
+                lkey = lkey[lvalid]
+                lvalid = np.ones(len(lkey), dtype=bool)
         # null keys never match
         lkey = np.where(lvalid, lkey, -1)
         rkey = np.where(rvalid, rkey, -2)
@@ -376,6 +377,7 @@ class Executor:
             else:
                 out[name] = Column(np.zeros(0, c.data.dtype), c.ctype,
                                    np.zeros(0, dtype=bool), c.dictionary)
+        self._grouping_ctx = ([n for n, _ in group_by], subset)
         for name, e in aggs:
             out[name] = self._eval_agg(t, e, gids, ngroups, n)
         if not key_cols and n == 0:
@@ -398,6 +400,16 @@ class Executor:
             return ex.cast_column(
                 self._eval_agg(t, e.operand, gids, ngroups, n), e.target)
         if isinstance(e, ex.Func):
+            if e.name == "grouping":
+                # grouping(key) = 0 when the key participates in this
+                # grouping set, 1 when it was rolled up (Spark semantics)
+                names, subset = self._grouping_ctx
+                arg = e.args[0]
+                idx = names.index(arg.name) if isinstance(
+                    arg, ex.ColumnRef) and arg.name in names else -1
+                active = subset is None or idx in subset
+                return Column(
+                    np.full(ngroups, 0 if active else 1, np.int32), INT32)
             cols = {f"__a{i}": self._eval_agg(t, a, gids, ngroups, n)
                     for i, a in enumerate(e.args)}
             tbl = Table(cols)

@@ -311,9 +311,6 @@ class Planner:
                 has_window = True
             bound.append((alias, be))
 
-        if has_agg and has_window:
-            raise PlanError("window + aggregate in one select unsupported")
-
         if has_agg:
             plan, cols = self._plan_aggregate(plan, sel, scope, items, bound,
                                               order_by)
@@ -425,25 +422,32 @@ class Planner:
                 isinstance(conj.operand, ast.Exists):
             return True, self._plan_exists(plan, conj.operand.query,
                                            not conj.operand.negated, scope)
-        # comparison against correlated scalar aggregate
+        # comparison against a (possibly arithmetic-wrapped) correlated
+        # scalar aggregate: x > (sub), x > 1.2 * (sub), ...
         if isinstance(conj, ast.Bin) and conj.op in ("=", "<>", "<", "<=",
                                                      ">", ">="):
             for this, other, flip in ((conj.right, conj.left, False),
                                       (conj.left, conj.right, True)):
-                if isinstance(this, ast.ScalarQuery):
-                    sub_scope = Scope(scope)
-                    sub_plan, sub_cols = self.plan_query(this.query,
-                                                         sub_scope)
-                    if sub_scope.outer_refs:
-                        op = conj.op if not flip else _flip_op(conj.op)
-                        return True, self._plan_corr_scalar_cmp(
-                            plan, other, op, sub_plan, sub_cols, scope)
-                    # uncorrelated: leave as SubqueryExpr literal
-                    be = ex.BinOp(
-                        conj.op,
-                        self._bind(conj.left, scope),
-                        self._bind(conj.right, scope))
-                    return True, lp.Filter(plan, be)
+                sub = _find_scalar_subquery(this)
+                if sub is None:
+                    continue
+                sub_scope = Scope(scope)
+                sub_plan, sub_cols = self.plan_query(sub.query, sub_scope)
+                if sub_scope.outer_refs:
+                    op = conj.op if not flip else _flip_op(conj.op)
+                    # wrapper expression around the subquery value
+                    marker = "__scalar__"
+                    wrapped_ast = _replace_scalar_subquery(
+                        this, sub, ast.Col(None, marker))
+                    return True, self._plan_corr_scalar_cmp(
+                        plan, other, op, sub_plan, sub_cols, scope,
+                        wrapped_ast, marker)
+                # uncorrelated: leave as SubqueryExpr literal
+                be = ex.BinOp(
+                    conj.op,
+                    self._bind(conj.left, scope),
+                    self._bind(conj.right, scope))
+                return True, lp.Filter(plan, be)
         return False, plan
 
     def _plan_in_subquery(self, plan: lp.Plan, node: ast.InQuery,
@@ -531,9 +535,11 @@ class Planner:
 
     def _plan_corr_scalar_cmp(self, plan: lp.Plan, other_ast: ast.Node,
                               op: str, sub_plan: lp.Plan,
-                              sub_cols: List[str],
-                              scope: Scope) -> lp.Plan:
-        """outer_expr <op> (correlated scalar aggregate subquery)."""
+                              sub_cols: List[str], scope: Scope,
+                              wrapper_ast: Optional[ast.Node] = None,
+                              marker: Optional[str] = None) -> lp.Plan:
+        """outer_expr <op> f(correlated scalar aggregate subquery) — f is an
+        optional arithmetic wrapper with the subquery replaced by `marker`."""
         sub_plan, corr = self._extract_correlation(sub_plan, scope)
         if not corr:
             raise PlanError("correlated scalar subquery without equality "
@@ -552,7 +558,14 @@ class Planner:
         val_col = sub_cols[0]
         keys = [(ex.ColumnRef(o), ex.ColumnRef(i)) for o, i in corr]
         joined = lp.Join(plan, sub_plan, "inner", keys)
-        cond = ex.BinOp(op, other, ex.ColumnRef(val_col))
+        if wrapper_ast is not None and not (
+                isinstance(wrapper_ast, ast.Col) and
+                wrapper_ast.name == marker):
+            value = self._bind(wrapper_ast, scope,
+                               alias_map={marker: ex.ColumnRef(val_col)})
+        else:
+            value = ex.ColumnRef(val_col)
+        cond = ex.BinOp(op, other, value)
         filtered = lp.Filter(joined, cond)
         # project away subquery columns
         keep = self._plan_output_names(plan)
@@ -604,17 +617,35 @@ class Planner:
                     gsets.append(idxs)
 
         aggs: List[Tuple[str, ex.Expr]] = []
+        wexprs: List[Tuple[str, ex.Expr]] = []  # windows over the aggregate
         out_names: List[str] = []
         out_exprs: List[Tuple[str, ex.Expr]] = []
 
+        agg_seen: Dict[str, str] = {}  # repr(AggExpr) -> hidden column name
+
+        def hidden_agg(be: ex.Expr) -> ex.Expr:
+            r = repr(be)
+            if r not in agg_seen:
+                h = self.fresh("a")
+                aggs.append((h, be))
+                agg_seen[r] = h
+            return ex.ColumnRef(agg_seen[r])
+
         def to_agg_output(be: ex.Expr) -> ex.Expr:
-            """Replace group-key subtrees with key refs; collect whole expr
-            as aggregate output."""
+            """Rewrite a select expression into one over the aggregate's
+            OUTPUT columns: group-key subtrees -> key refs, AggExprs (and
+            grouping()) -> hidden aggregate columns, windows hoisted above
+            the Aggregate node."""
             r = repr(be)
             if r in key_repr:
                 return ex.ColumnRef(key_repr[r])
             if isinstance(be, ex.AggExpr):
-                return be
+                return hidden_agg(be)
+            if isinstance(be, ex.Func) and be.name == "grouping":
+                # rewrite the argument to the generated group-key name so the
+                # executor can match it against the grouping-set subset
+                return hidden_agg(ex.Func(
+                    "grouping", (to_agg_output(be.args[0]),)))
             if isinstance(be, ex.BinOp):
                 return ex.BinOp(be.op, to_agg_output(be.left),
                                 to_agg_output(be.right))
@@ -623,6 +654,9 @@ class Planner:
             if isinstance(be, ex.Func):
                 return ex.Func(be.name,
                                tuple(to_agg_output(a) for a in be.args))
+            if isinstance(be, ex.InList):
+                return ex.InList(to_agg_output(be.operand), be.values,
+                                 be.negated)
             if isinstance(be, ex.Case):
                 return ex.Case(tuple((to_agg_output(c), to_agg_output(v))
                                      for c, v in be.whens),
@@ -632,6 +666,20 @@ class Planner:
                 return be
             if isinstance(be, ex.UnaryOp):
                 return ex.UnaryOp(be.op, to_agg_output(be.operand))
+            if isinstance(be, ex.WindowExpr):
+                # window over the aggregate output (revenue-ratio pattern):
+                # components become post-aggregate exprs, the WindowExpr is
+                # hoisted above the Aggregate node
+                w2 = ex.WindowExpr(
+                    be.func,
+                    None if be.arg is None or isinstance(be.arg, ex.Star)
+                    else to_agg_output(be.arg),
+                    tuple(to_agg_output(x) for x in be.partition_by),
+                    tuple((to_agg_output(o), asc)
+                          for o, asc in be.order_by))
+                name = self.fresh("w")
+                wexprs.append((name, w2))
+                return ex.ColumnRef(name)
             raise PlanError(
                 f"select expr not derivable from group keys/aggregates: {be}")
 
@@ -643,14 +691,7 @@ class Planner:
                 name = f"{name}_{seen_names[name]}"
             else:
                 seen_names[name] = 0
-            rewritten = to_agg_output(be)
-            if isinstance(rewritten, ex.ColumnRef) and \
-                    rewritten.name in [n for n, _ in group_keys]:
-                out_exprs.append((name, rewritten))
-            else:
-                hidden = self.fresh("a")
-                aggs.append((hidden, rewritten))
-                out_exprs.append((name, ex.ColumnRef(hidden)))
+            out_exprs.append((name, to_agg_output(be)))
             out_names.append(name)
 
         agg_plan = lp.Aggregate(plan, group_keys, aggs, gsets)
@@ -658,17 +699,11 @@ class Planner:
         if sel.having is not None:
             hb = self._bind(sel.having, scope, allow_aggs=True,
                             alias_map=alias_map)
-            hv = to_agg_output(hb)
-            if _contains_agg(hv):
-                hidden = self.fresh("h")
-                agg_plan.aggs.append((hidden, hv))
-                agg_plan = lp.Filter(agg_plan, ex.ColumnRef(hidden))
-            else:
-                agg_plan = lp.Filter(agg_plan, hv)
+            agg_plan = lp.Filter(agg_plan, to_agg_output(hb))
 
+        keys: List[Tuple] = []
+        hidden: List[Tuple[str, ex.Expr]] = []
         if order_by:
-            keys: List[Tuple[ex.Expr, bool]] = []
-            hidden: List[Tuple[str, ex.Expr]] = []
             for e, asc, nf in order_by:
                 try:
                     keys.append((self._resolve_order_key(e, out_names, bound,
@@ -678,15 +713,19 @@ class Planner:
                     pass
                 be = self._bind(e, scope, allow_aggs=True,
                                 alias_map=alias_map)
-                rewritten = to_agg_output(be)
+                # to_agg_output registers new aggregates on the shared aggs
+                # list (the Aggregate node holds the same object) and may
+                # hoist new window exprs — the Window node is built below,
+                # after all select AND order-by expressions are processed
                 name = self.fresh("o")
-                if _contains_agg(rewritten):
-                    base = _find_aggregate(agg_plan)
-                    base.aggs.append((name, rewritten))
-                    hidden.append((name, ex.ColumnRef(name)))
-                else:
-                    hidden.append((name, rewritten))
+                hidden.append((name, to_agg_output(be)))
                 keys.append((ex.ColumnRef(name), asc, nf))
+
+        if wexprs:
+            # windows computed over the (filtered) aggregate output
+            agg_plan = lp.Window(agg_plan, wexprs)
+
+        if order_by:
             proj = lp.Project(lp.Sort(
                 lp.Project(agg_plan, out_exprs + hidden), keys),
                 [(n, ex.ColumnRef(n)) for n in out_names])
@@ -929,6 +968,38 @@ def _conjoin(parts: Sequence[ex.Expr]) -> Optional[ex.Expr]:
 def _flip_op(op: str) -> str:
     return {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
             "=": "=", "<>": "<>"}[op]
+
+
+def _find_scalar_subquery(e: ast.Node):
+    """First ScalarQuery inside an arithmetic wrapper (Bin/Un/Cast chains)."""
+    if isinstance(e, ast.ScalarQuery):
+        return e
+    if isinstance(e, ast.Bin):
+        return _find_scalar_subquery(e.left) or \
+            _find_scalar_subquery(e.right)
+    if isinstance(e, ast.Un):
+        return _find_scalar_subquery(e.operand)
+    if isinstance(e, ast.CastExpr):
+        return _find_scalar_subquery(e.operand)
+    return None
+
+
+def _replace_scalar_subquery(e: ast.Node, target, replacement) -> ast.Node:
+    if e is target:
+        return replacement
+    if isinstance(e, ast.Bin):
+        return ast.Bin(e.op,
+                       _replace_scalar_subquery(e.left, target, replacement),
+                       _replace_scalar_subquery(e.right, target, replacement))
+    if isinstance(e, ast.Un):
+        return ast.Un(e.op,
+                      _replace_scalar_subquery(e.operand, target,
+                                               replacement))
+    if isinstance(e, ast.CastExpr):
+        return ast.CastExpr(
+            _replace_scalar_subquery(e.operand, target, replacement),
+            e.type_name)
+    return e
 
 
 def _contains_agg(e: ex.Expr) -> bool:

@@ -9,7 +9,6 @@ nds_maintenance.py:107-116).
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -135,17 +134,24 @@ class Session:
             from ndstpu.io import acid
             root = os.path.join(self.warehouse, stmt.table)
             if acid.is_ndslake(root):
-                # the predicate mask computed on the in-memory view applies
-                # row-for-row only if file order matches; delete via
-                # re-evaluation per data file for correctness
-                offset = [0]
+                # re-evaluate the WHERE per data file — never assume the
+                # in-memory row order matches file iteration order
+                if stmt.where is None:
+                    acid.delete_rows(
+                        root, lambda at: np.ones(at.num_rows, dtype=bool))
+                else:
+                    from ndstpu import schema as nds_schema
+                    try:
+                        sch = nds_schema.get_schema(stmt.table)
+                    except KeyError:
+                        sch = None
 
-                def pred(at):
-                    import pyarrow as pa  # noqa: F401
-                    n = at.num_rows
-                    m = mask[offset[0]:offset[0] + n]
-                    offset[0] += n
-                    return m
-                acid.delete_rows(root, pred)
+                    def pred(at):
+                        t = columnar.from_arrow(at, sch)
+                        rn = columnar.Table(
+                            {f"{stmt.table}.{n}": c
+                             for n, c in t.columns.items()})
+                        return ex.eval_predicate(rn, bound)
+                    acid.delete_rows(root, pred)
         self.catalog.register(stmt.table, target.filter(~mask))
         return None

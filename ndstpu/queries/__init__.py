@@ -1,0 +1,1 @@
+"""Query corpus + reproducible stream generation (dsqgen analog)."""

@@ -1,0 +1,176 @@
+"""Query-stream generation — the dsqgen analog.
+
+Renders the template corpus into per-stream query files with the same
+contract as the reference (nds_gen_query_stream.py + patched spark.tpl
+dialect):
+
+* ``-- start query N in stream M using template queryX.tpl`` / matching
+  ``-- end`` markers (the parsing contract of the power runner,
+  reference nds_power.py:49-76)
+* per-stream permuted query order and per-(stream, template) substitution
+  parameters, both deterministic in ``--rngseed`` (TPC-DS spec 4.3.1
+  reproducibility)
+* ``--template`` single-template mode for testing, including the two-part
+  split files (_part1/_part2) for the multi-statement templates
+  (reference nds_gen_query_stream.py:91-103)
+
+Templates declare parameters in a header line per parameter:
+    --@ define NAME = uniform(lo, hi)      integer uniform inclusive
+    --@ define NAME = choice(v1, v2, ...)  pick one literal
+``[NAME]`` occurrences in the body are substituted.  Arithmetic like
+``[NAME] + 10`` stays in SQL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import random
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+TEMPLATE_DIR = Path(__file__).resolve().parent / "templates"
+
+_DEFINE_RE = re.compile(
+    r"^--@\s*define\s+(\w+)\s*=\s*(uniform|choice)\((.*)\)\s*$")
+
+
+def list_templates(template_dir: Optional[str] = None) -> List[str]:
+    d = Path(template_dir) if template_dir else TEMPLATE_DIR
+    return sorted((p.name for p in d.glob("query*.tpl")),
+                  key=lambda n: int(re.findall(r"\d+", n)[0]))
+
+
+def _parse_template(text: str) -> Tuple[Dict[str, tuple], str]:
+    params: Dict[str, tuple] = {}
+    body_lines = []
+    for line in text.splitlines():
+        m = _DEFINE_RE.match(line.strip())
+        if m:
+            name, kind, args = m.groups()
+            vals = [a.strip() for a in args.split(",")]
+            params[name] = (kind, vals)
+        else:
+            body_lines.append(line)
+    return params, "\n".join(body_lines).strip()
+
+
+def _stable_seed(rngseed: str, stream: int, template: str) -> int:
+    h = hashlib.sha256(f"{rngseed}|{stream}|{template}".encode()).digest()
+    return int.from_bytes(h[:8], "big")
+
+
+def render_template(template_path: str, rngseed: str, stream: int) -> str:
+    text = Path(template_path).read_text()
+    params, body = _parse_template(text)
+    rng = random.Random(_stable_seed(rngseed, stream,
+                                     Path(template_path).name))
+    for name, (kind, vals) in params.items():
+        if kind == "uniform":
+            v = str(rng.randint(int(vals[0]), int(vals[1])))
+        else:  # choice
+            v = rng.choice(vals).strip()
+            if v.startswith("'") and v.endswith("'"):
+                v = v[1:-1]
+        body = body.replace(f"[{name}]", v)
+    leftover = re.findall(r"\[([A-Z][A-Z0-9_]*)\]", body)
+    if leftover:
+        raise ValueError(
+            f"{template_path}: unsubstituted parameters {sorted(set(leftover))}")
+    return body
+
+
+def _query_order(templates: List[str], rngseed: str,
+                 stream: int) -> List[str]:
+    """Stream 0 = canonical order (the Power Run); streams >= 1 get a
+    deterministic permutation (TPC-DS per-stream ordering)."""
+    if stream == 0:
+        return list(templates)
+    rng = random.Random(_stable_seed(rngseed, stream, "__order__"))
+    out = list(templates)
+    rng.shuffle(out)
+    return out
+
+
+def generate_query_streams(template_dir: Optional[str], rngseed: str,
+                           output_dir: str, streams: int) -> List[str]:
+    """Write query_{stream}.sql for streams 0..N-1; returns file paths."""
+    os.makedirs(output_dir, exist_ok=True)
+    d = Path(template_dir) if template_dir else TEMPLATE_DIR
+    templates = list_templates(template_dir)
+    if not templates:
+        raise FileNotFoundError(f"no query*.tpl under {d}")
+    paths = []
+    for stream in range(streams):
+        parts = []
+        order = _query_order(templates, rngseed, stream)
+        for i, tpl in enumerate(order):
+            sql = render_template(str(d / tpl), rngseed, stream)
+            if not sql.rstrip().endswith(";"):
+                sql = sql.rstrip() + "\n;"
+            parts.append(
+                f"-- start query {i + 1} in stream {stream} "
+                f"using template {tpl}\n{sql}\n"
+                f"-- end query {i + 1} in stream {stream} "
+                f"using template {tpl}\n")
+        path = os.path.join(output_dir, f"query_{stream}.sql")
+        with open(path, "w") as f:
+            f.write("\n".join(parts))
+        paths.append(path)
+    return paths
+
+
+def generate_single_template(template: str, template_dir: Optional[str],
+                             rngseed: str, output_dir: str) -> List[str]:
+    """Render one template (test mode).  Multi-statement templates are split
+    into _part1/_part2 files like the reference (nds_gen_query_stream.py:91-103)."""
+    os.makedirs(output_dir, exist_ok=True)
+    d = Path(template_dir) if template_dir else TEMPLATE_DIR
+    name = template if template.endswith(".tpl") else template + ".tpl"
+    sql = render_template(str(d / name), rngseed, 0)
+    stmts = [s.strip() for s in sql.split(";") if s.strip()]
+    base = name[:-4]
+    out_paths = []
+    if len(stmts) > 1:
+        for k, stmt in enumerate(stmts, 1):
+            p = os.path.join(output_dir, f"{base}_part{k}.sql")
+            with open(p, "w") as f:
+                f.write(stmt + ";\n")
+            out_paths.append(p)
+    else:
+        p = os.path.join(output_dir, f"{base}.sql")
+        with open(p, "w") as f:
+            f.write(stmts[0] + ";\n")
+        out_paths.append(p)
+    return out_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="NDS query stream generator")
+    p.add_argument("--template_dir",
+                   help="directory of query templates "
+                        "(default: builtin corpus)")
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--rngseed", default="0",
+                   help="RNG seed (chained from the load test end timestamp "
+                        "per TPC-DS spec 4.3.1)")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--template",
+                   help="render one template (test mode)")
+    g.add_argument("--streams", type=int,
+                   help="generate N permuted full streams")
+    return p
+
+
+if __name__ == "__main__":
+    args = build_parser().parse_args()
+    if args.template:
+        out = generate_single_template(args.template, args.template_dir,
+                                       args.rngseed, args.output_dir)
+    else:
+        out = generate_query_streams(args.template_dir, args.rngseed,
+                                     args.output_dir, args.streams)
+    for p in out:
+        print(p)

@@ -1,0 +1,23 @@
+--@ define SDATE = choice('1998-03-10', '1999-03-10', '2000-03-10', '2001-03-10')
+select w_warehouse_name, i_item_id,
+       sum(case when d_date < cast('[SDATE]' as date)
+                then inv_quantity_on_hand else 0 end) as inv_before,
+       sum(case when d_date >= cast('[SDATE]' as date)
+                then inv_quantity_on_hand else 0 end) as inv_after
+from inventory, warehouse, item, date_dim
+where i_item_sk = inv_item_sk
+  and inv_warehouse_sk = w_warehouse_sk
+  and inv_date_sk = d_date_sk
+  and i_current_price between 0.99 and 49.99
+  and d_date between (cast('[SDATE]' as date) - interval 30 days)
+                 and (cast('[SDATE]' as date) + interval 30 days)
+group by w_warehouse_name, i_item_id
+having (case when sum(case when d_date < cast('[SDATE]' as date)
+                           then inv_quantity_on_hand else 0 end) > 0
+             then sum(case when d_date >= cast('[SDATE]' as date)
+                           then inv_quantity_on_hand else 0 end) * 1.0 /
+                  sum(case when d_date < cast('[SDATE]' as date)
+                           then inv_quantity_on_hand else 0 end)
+             else null end) between 0.666667 and 1.5
+order by w_warehouse_name, i_item_id
+limit 100

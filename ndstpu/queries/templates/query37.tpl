@@ -1,0 +1,16 @@
+--@ define PRICE = uniform(10, 60)
+--@ define MANU1 = uniform(1, 800)
+--@ define SDATE = choice('1998-06-02', '1999-06-02', '2000-06-02', '2001-06-02')
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, catalog_sales
+where i_current_price between [PRICE] and [PRICE] + 30
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between cast('[SDATE]' as date)
+                 and (cast('[SDATE]' as date) + interval 60 days)
+  and i_manufact_id in ([MANU1], [MANU1] + 10, [MANU1] + 20, [MANU1] + 30)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100

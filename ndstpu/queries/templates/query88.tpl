@@ -1,0 +1,34 @@
+--@ define DEP = uniform(0, 9)
+select *
+from (select count(*) h8_30_to_9
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 8 and time_dim.t_minute >= 30
+        and household_demographics.hd_dep_count = [DEP]
+        and store.s_store_name = 'ese') s1,
+     (select count(*) h9_to_9_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute < 30
+        and household_demographics.hd_dep_count = [DEP]
+        and store.s_store_name = 'ese') s2,
+     (select count(*) h9_30_to_10
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 9 and time_dim.t_minute >= 30
+        and household_demographics.hd_dep_count = [DEP]
+        and store.s_store_name = 'ese') s3,
+     (select count(*) h10_to_10_30
+      from store_sales, household_demographics, time_dim, store
+      where ss_sold_time_sk = time_dim.t_time_sk
+        and ss_hdemo_sk = household_demographics.hd_demo_sk
+        and ss_store_sk = s_store_sk
+        and time_dim.t_hour = 10 and time_dim.t_minute < 30
+        and household_demographics.hd_dep_count = [DEP]
+        and store.s_store_name = 'ese') s4
