@@ -67,7 +67,9 @@ def _move_into_table_dirs(data_dir: str, range_start: int, range_end: int,
         for child in range(range_start, range_end + 1):
             src = os.path.join(data_dir, f"{table}_{child}_{parallel}.dat")
             if os.path.exists(src):
-                shutil.move(src, tdir)
+                # full destination path so a re-run with --overwrite_output
+                # replaces existing chunks (os.rename semantics)
+                shutil.move(src, os.path.join(tdir, os.path.basename(src)))
 
 
 def _merge_temp_tables(temp_dir: str, parent_dir: str,

@@ -1439,20 +1439,21 @@ int main(int argc, char** argv) {
     // unit count (rows or orders) is chunked across -parallel children so
     // the driver's fan-out never duplicates content.
     int64_t orders = lin(sf, 1500);
+    auto at_least_1 = [](int64_t n) { return n < 1 ? 1 : n; };
     struct {
       const char* name;
       int which;
       int64_t n;
     } jobs[] = {{"s_purchase", 0, orders},
                 {"s_purchase_lineitem", 1, orders},
-                {"s_catalog_order", 2, orders / 2},
-                {"s_catalog_order_lineitem", 3, orders / 2},
-                {"s_web_order", 4, orders / 3},
-                {"s_web_order_lineitem", 5, orders / 3},
-                {"s_store_returns", 6, orders / 5},
-                {"s_catalog_returns", 7, orders / 8},
-                {"s_web_returns", 8, orders / 10},
-                {"s_inventory", 9, orders / 2},
+                {"s_catalog_order", 2, at_least_1(orders / 2)},
+                {"s_catalog_order_lineitem", 3, at_least_1(orders / 2)},
+                {"s_web_order", 4, at_least_1(orders / 3)},
+                {"s_web_order_lineitem", 5, at_least_1(orders / 3)},
+                {"s_store_returns", 6, at_least_1(orders / 5)},
+                {"s_catalog_returns", 7, at_least_1(orders / 8)},
+                {"s_web_returns", 8, at_least_1(orders / 10)},
+                {"s_inventory", 9, at_least_1(orders / 2)},
                 {"delete", 10, 1},
                 {"inventory_delete", 11, 1}};
     for (auto& j : jobs) {

@@ -211,15 +211,42 @@ def common_type(a: DType, b: DType) -> DType:
 def cast_column(c: Column, target: DType) -> Column:
     k, tk = c.ctype.kind, target.kind
     if k == tk and (tk != "decimal" or c.ctype.scale == target.scale):
+        if tk == "decimal" and c.ctype.precision != target.precision:
+            # same scale -> same representation; retag the precision, but
+            # values that overflow the narrower precision become NULL
+            # (Spark non-ANSI overflow semantics)
+            if target.precision < c.ctype.precision:
+                limit = 10 ** target.precision
+                ok = np.abs(c.data) < limit
+                valid = ok if c.valid is None else (c.valid & ok)
+                return Column(c.data, target,
+                              None if valid.all() else valid, c.dictionary)
+            return Column(c.data, target, c.valid, c.dictionary)
         return c
     v = c.valid
+
+    def _strings_to_floats():
+        """Per-value parse; unparseable -> NULL (Spark cast semantics)."""
+        out = np.zeros(len(c.data), dtype=np.float64)
+        valid = c.validity().copy()
+        for i, x in enumerate(c.to_pylist()):
+            if x is None:
+                valid[i] = False
+                continue
+            try:
+                out[i] = float(x)
+            except ValueError:
+                valid[i] = False
+        return out, (None if valid.all() else valid)
+
+    def _half_up(x: np.ndarray) -> np.ndarray:
+        return np.floor(np.abs(x) + 0.5) * np.sign(x)
+
     if tk == "float64":
         if k == "decimal":
             data = c.data.astype(np.float64) / (10 ** c.ctype.scale)
         elif k == "string":
-            vals = np.array(
-                [float(x) if x is not None else 0.0 for x in c.to_pylist()])
-            data = vals
+            data, v = _strings_to_floats()
         else:
             data = c.data.astype(np.float64)
         return Column(data, FLOAT64, v)
@@ -230,11 +257,10 @@ def cast_column(c: Column, target: DType) -> Column:
             data = (c.data * (10 ** shift) if shift >= 0
                     else _div_round_half_up(c.data, 10 ** (-shift)))
         elif k == "float64":
-            data = np.round(c.data * scale)
+            data = _half_up(c.data * scale)  # Spark HALF_UP, not banker's
         elif k == "string":
-            data = np.round(np.array(
-                [float(x) if x is not None else 0.0
-                 for x in c.to_pylist()]) * scale)
+            floats, v = _strings_to_floats()
+            data = _half_up(floats * scale)
         else:
             data = c.data.astype(np.int64) * scale
         return Column(data.astype(np.int64), target, v)

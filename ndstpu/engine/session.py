@@ -96,10 +96,18 @@ class Session:
     # -- DML against the warehouse (ACID ndslake tables) ---------------------
 
     def _insert(self, stmt: ast.InsertInto):
+        from ndstpu.engine import expr as ex
         rows = self._run(stmt.query)
         target = self.catalog.get(stmt.table)
-        rows = columnar.Table(dict(zip(target.column_names,
-                                       rows.columns.values())))
+        if len(rows.column_names) != len(target.column_names):
+            raise ValueError(
+                f"INSERT INTO {stmt.table}: {len(rows.column_names)} values "
+                f"for {len(target.column_names)} columns")
+        # positional mapping + cast to the target's exact column types
+        rows = columnar.Table({
+            name: ex.cast_column(col, target.column(name).ctype)
+            for name, col in zip(target.column_names,
+                                 rows.columns.values())})
         if self.warehouse is not None:
             import os
 

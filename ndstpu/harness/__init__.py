@@ -1,0 +1,2 @@
+"""Benchmark harness: power/throughput/maintenance runners, differential
+validation, full-bench orchestration and the composite NDS metric."""

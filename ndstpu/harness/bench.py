@@ -1,0 +1,223 @@
+"""Full-benchmark orchestrator (the nds_bench analog).
+
+Runs the five NDS phases end-to-end from a YAML config and computes the
+composite TPC-DS-style metric (reference: /root/reference/nds/nds_bench.py):
+
+  data gen -> load test (transcode) -> stream gen (RNGSEED chained from the
+  load report, spec 4.3.1) -> Power Test -> Throughput Test 1 -> Data
+  Maintenance 1 -> Throughput Test 2 -> Data Maintenance 2 -> metric
+
+Phase parity details: per-phase `skip:` flags reusing prior reports
+(nds_bench.py:368-399), throughput elapsed = max(end)-min(start) over the
+stream time logs rounded up to 0.1s (nds_bench.py:138-157,207-208), stream
+ranges split across the two throughput tests (nds_bench.py:120-135), and
+metric = int(SF * Sq*Q / (Tpt*Ttt*Tdm*Tld)^(1/4)) in decimal hours
+(nds_bench.py:334-357).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import subprocess
+import sys
+
+import yaml
+
+PY = [sys.executable, "-m"]
+
+
+def round_up_to_nearest_10_percent(num: float) -> float:
+    return math.ceil(num * 10) / 10
+
+
+def get_load_time(load_report_file: str) -> str:
+    with open(load_report_file) as f:
+        for line in f:
+            if "Load Test Time" in line:
+                return line.split(":")[1].split(" ")[1]
+    raise RuntimeError(f"Load Test Time not found in {load_report_file}")
+
+
+def get_load_end_timestamp(load_report_file: str) -> str:
+    with open(load_report_file) as f:
+        for line in f:
+            if "RNGSEED used:" in line:
+                return line.split(":")[1].strip()
+    raise RuntimeError(f"RNGSEED not found in {load_report_file}")
+
+
+def get_power_time(power_report_file: str) -> str:
+    with open(power_report_file) as f:
+        for line in f:
+            if "Power Test Time" in line:
+                return line.split(",")[2].strip()
+    raise RuntimeError(f"Power Test Time not found in {power_report_file}")
+
+
+def get_start_end_time(report_file: str):
+    start = end = None
+    with open(report_file) as f:
+        for line in f:
+            if "Power Start Time" in line:
+                start = line.split(",")[2].strip()
+            if "Power End Time" in line:
+                end = line.split(",")[2].strip()
+    if start is None or end is None:
+        raise RuntimeError(f"start/end time not found in {report_file}")
+    return start, end
+
+
+def get_stream_range(num_streams: int, first_or_second: int):
+    if first_or_second == 1:
+        return list(range(1, num_streams // 2 + 1))
+    return list(range(num_streams // 2 + 1, num_streams))
+
+
+def get_throughput_time(report_base: str, num_streams: int,
+                        first_or_second: int) -> float:
+    starts, ends = [], []
+    for i in get_stream_range(num_streams, first_or_second):
+        s, e = get_start_end_time(f"{report_base}_{i}.csv")
+        starts.append(float(s))
+        ends.append(float(e))
+    return round_up_to_nearest_10_percent(max(ends) - min(starts))
+
+
+def get_refresh_time(report_file: str) -> float:
+    with open(report_file) as f:
+        for line in f:
+            if "Data Maintenance Time" in line:
+                return float(line.split(",")[2].strip())
+    raise RuntimeError(f"Data Maintenance Time not found in {report_file}")
+
+
+def get_maintenance_time(report_base: str, num_streams: int,
+                         first_or_second: int) -> float:
+    tdm = 0.0
+    for i in get_stream_range(num_streams, first_or_second):
+        tdm += get_refresh_time(f"{report_base}_{i}.csv")
+    return round_up_to_nearest_10_percent(tdm)
+
+
+def get_perf_metric(scale_factor, num_streams_in_throughput, queries_per_stream,
+                    Tload, Tpower, Ttt1, Ttt2, Tdm1, Tdm2) -> int:
+    """Composite metric, times in decimal hours (nds_bench.py:334-357)."""
+    Q = num_streams_in_throughput * queries_per_stream
+    Tpt = (Tpower * num_streams_in_throughput) / 3600
+    Ttt = (Ttt1 + Ttt2) / 3600
+    Tdm = (Tdm1 + Tdm2) / 3600
+    Tld = (0.01 * num_streams_in_throughput * Tload) / 3600
+    return int(float(scale_factor) * Q / (Tpt * Ttt * Tdm * Tld) ** (1 / 4))
+
+
+def write_metrics_report(path: str, metrics: dict) -> None:
+    with open(path, "w") as f:
+        for k, v in metrics.items():
+            f.write(f"{k},{v}\n")
+
+
+def run(cmd, **kw):
+    print("====", " ".join(str(c) for c in cmd))
+    subprocess.run([str(c) for c in cmd], check=True, **kw)
+
+
+def run_full_bench(yaml_params: dict) -> None:
+    d = yaml_params["data_gen"]
+    l = yaml_params["load_test"]
+    g = yaml_params["generate_query_stream"]
+    p = yaml_params["power_test"]
+    t = yaml_params["throughput_test"]
+    m = yaml_params["maintenance_test"]
+    mtr = yaml_params["metrics"]
+    sf = str(d["scale_factor"])
+    num_streams = int(g["num_streams"])
+    sq = max(len(get_stream_range(num_streams, 1)), 1)
+
+    # 1. data generation (+ per-stream refresh sets)
+    if not d.get("skip"):
+        run(PY + ["ndstpu.datagen.driver", "local", sf,
+                  str(d["parallel"]), d["data_path"], "--overwrite_output"])
+        for i in range(1, num_streams):
+            run(PY + ["ndstpu.datagen.driver", "local", sf,
+                      str(d["parallel"]), d["data_path"] + f"_{i}",
+                      "--overwrite_output", "--update", str(i)])
+
+    # 2. load test
+    if not l.get("skip"):
+        run(PY + ["ndstpu.io.transcode",
+                  "--input_prefix", d["data_path"],
+                  "--output_prefix", l["warehouse_path"],
+                  "--report_file", l["report_file"],
+                  "--output_format", l.get("warehouse_format", "parquet")])
+    load_elapse = get_load_time(l["report_file"])
+
+    # 3. query streams (RNGSEED = load end timestamp, spec 4.3.1)
+    if not g.get("skip"):
+        rngseed = get_load_end_timestamp(l["report_file"])
+        run(PY + ["ndstpu.queries.streamgen",
+                  "--output_dir", g["stream_output_path"],
+                  "--rngseed", rngseed,
+                  "--streams", str(num_streams)])
+
+    # 4. power test
+    if not p.get("skip"):
+        if p.get("json_summary_folder"):
+            import shutil
+            shutil.rmtree(p["json_summary_folder"], ignore_errors=True)
+        cmd = PY + ["ndstpu.harness.power",
+                    os.path.join(g["stream_output_path"], "query_0.sql"),
+                    l["warehouse_path"], p["report_file"],
+                    "--engine", p.get("engine", "cpu")]
+        if p.get("json_summary_folder"):
+            cmd += ["--json_summary_folder", p["json_summary_folder"]]
+        if p.get("output_prefix"):
+            cmd += ["--output_prefix", p["output_prefix"]]
+        run(cmd)
+    power_elapse = float(get_power_time(p["report_file"])) / 1000
+
+    # 5./6. throughput + maintenance, twice
+    ttt, tdm = {}, {}
+    for fs in (1, 2):
+        if not t.get("skip"):
+            ids = ",".join(str(x) for x in get_stream_range(num_streams, fs))
+            run(PY + ["ndstpu.harness.throughput", ids, "--"] +
+                PY + ["ndstpu.harness.power",
+                      os.path.join(g["stream_output_path"], "query_{}.sql"),
+                      l["warehouse_path"],
+                      t["report_base"] + "_{}.csv",
+                      "--engine", p.get("engine", "cpu")])
+        ttt[fs] = get_throughput_time(t["report_base"], num_streams, fs)
+        if not m.get("skip"):
+            for i in get_stream_range(num_streams, fs):
+                run(PY + ["ndstpu.harness.maintenance",
+                          l["warehouse_path"],
+                          d["data_path"] + f"_{i}",
+                          m["report_base"] + f"_{i}.csv"])
+        tdm[fs] = get_maintenance_time(m["report_base"], num_streams, fs)
+
+    qps = len(__import__("ndstpu.queries.streamgen",
+                         fromlist=["list_templates"]).list_templates())
+    metric = get_perf_metric(sf, sq, qps, float(load_elapse), power_elapse,
+                             ttt[1], ttt[2], tdm[1], tdm[2])
+    metrics = {
+        "scale_factor": sf,
+        "num_streams": num_streams,
+        "queries_per_stream": qps,
+        "Tload(s)": load_elapse,
+        "Tpower(s)": power_elapse,
+        "Ttt1(s)": ttt[1], "Ttt2(s)": ttt[2],
+        "Tdm1(s)": tdm[1], "Tdm2(s)": tdm[2],
+        "metric": metric,
+    }
+    print(metrics)
+    write_metrics_report(mtr["metrics_report"], metrics)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="NDS full benchmark")
+    parser.add_argument("yaml_config", help="yaml config file (bench.yml)")
+    with open(parser.parse_args().yaml_config) as f:
+        params = yaml.safe_load(f)
+    run_full_bench(params)

@@ -1,0 +1,208 @@
+"""Power run: execute a query stream serially on the engine, timed.
+
+Parity with the reference's power runner (/root/reference/nds/nds_power.py):
+stream-file parsing on the `-- start` marker contract incl. two-part query
+splitting (nds_power.py:49-76), per-query BenchReport JSON summaries, the
+`application_id,query,time/milliseconds` CSV time log with Power Start/End/
+Test/Total rows (nds_power.py:247-299), `--sub_queries` subsets, and query
+output collection or writing (with output column-name sanitization,
+nds_power.py:136-173).
+
+The Spark-submit + session-build layer maps to: load the warehouse catalog
+(TempView registration analog, nds_power.py:78-121), optional property file
+of engine knobs, and `--engine cpu|tpu` to pick the numpy interpreter or the
+JAX/XLA path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import os
+import re
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ndstpu.check import check_json_summary_folder, check_query_subset_exists
+from ndstpu.engine import columnar
+from ndstpu.engine.session import Session
+from ndstpu.harness.report import BenchReport
+from ndstpu.io import loader
+
+
+def gen_sql_from_stream(query_stream_file_path: str) -> "OrderedDict[str, str]":
+    """Split a stream file into {query_name: sql}, splitting two-part
+    queries into `_part1`/`_part2` (contract: nds_power.py:49-76)."""
+    with open(query_stream_file_path) as f:
+        stream = f.read()
+    all_queries = stream.split("-- start")[1:]
+    extended = OrderedDict()
+    for q in all_queries:
+        name = q[q.find("template") + 9:q.find(".tpl")]
+        body = q.split(";")
+        if len(body) > 2 and "select" in body[1].lower():
+            head = body[0].split("\n", 1)
+            extended[name + "_part1"] = head[1] + ";"
+            extended[name + "_part2"] = body[1] + ";"
+        else:
+            extended[name] = "-- start" + q
+    return extended
+
+
+def ensure_valid_column_names(table: columnar.Table) -> columnar.Table:
+    """Sanitize output column names for file formats
+    (reference: nds_power.py:136-173)."""
+    def ok(name: str) -> bool:
+        return re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", name) is not None
+
+    cols = {}
+    for i, (n, c) in enumerate(table.columns.items()):
+        cols[n if ok(n) else f"column_{i}"] = c
+    return columnar.Table(cols)
+
+
+def get_query_subset(query_dict, subset: List[str]):
+    check_query_subset_exists(query_dict, subset)
+    return OrderedDict((q, query_dict[q]) for q in subset)
+
+
+def run_one_query(session: Session, query: str, query_name: str,
+                  output_path: Optional[str], output_format: str) -> None:
+    result = session.sql(query)
+    if result is None:
+        return
+    if not output_path:
+        result.to_rows()  # the collect() analog — materialize to host
+        return
+    out = ensure_valid_column_names(result)
+    dest = os.path.join(output_path, query_name)
+    os.makedirs(dest, exist_ok=True)
+    at = columnar.to_arrow(out)
+    if output_format == "parquet":
+        import pyarrow.parquet as pq
+        pq.write_table(at, os.path.join(dest, "part-0.parquet"))
+    elif output_format == "csv":
+        import pyarrow.csv as pacsv
+        pacsv.write_csv(at, os.path.join(dest, "part-0.csv"))
+    else:
+        raise ValueError(f"unsupported output format {output_format}")
+
+
+def load_properties(filename: str) -> Dict[str, str]:
+    """java-properties style engine config (reference: nds_power.py:306-312)."""
+    props = {}
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+                props[k.strip()] = v.strip()
+    return props
+
+
+def run_query_stream(args) -> None:
+    total_start = time.time()
+    execution_times = []
+    app_id = f"ndstpu-{uuid.uuid4().hex[:12]}"
+
+    engine_conf: Dict[str, str] = {}
+    if args.property_file:
+        engine_conf.update(load_properties(args.property_file))
+    engine_conf.setdefault("engine", args.engine)
+    engine_conf.setdefault("input_format", args.input_format)
+
+    query_dict = gen_sql_from_stream(args.query_stream_file)
+
+    # catalog load == table registration (TempView analog)
+    load_start = time.time()
+    catalog = loader.load_catalog(args.input_prefix,
+                                  use_decimal=not args.floats)
+    sess = Session(catalog, backend=args.engine)
+    execution_times.append(
+        (app_id, "CreateTempView all tables",
+         int((time.time() - load_start) * 1000)))
+
+    check_json_summary_folder(args.json_summary_folder)
+    if args.sub_queries:
+        query_dict = get_query_subset(query_dict,
+                                      args.sub_queries.split(","))
+
+    power_start = int(time.time())
+    for query_name, q_content in query_dict.items():
+        print(f"====== Run {query_name} ======")
+        q_report = BenchReport(engine_conf)
+        summary = q_report.report_on(run_one_query, sess, q_content,
+                                     query_name, args.output_prefix,
+                                     args.output_format)
+        print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
+        execution_times.append((app_id, query_name,
+                                summary["queryTimes"][0]))
+        if args.json_summary_folder:
+            if args.property_file:
+                prefix = os.path.join(
+                    args.json_summary_folder,
+                    os.path.basename(args.property_file).split(".")[0])
+            else:
+                prefix = os.path.join(args.json_summary_folder, "")
+            q_report.write_summary(query_name, prefix=prefix)
+    power_end = int(time.time())
+    power_elapse = int((power_end - power_start) * 1000)
+    total_elapse = int((time.time() - total_start) * 1000)
+    print(f"====== Power Test Time: {power_elapse} milliseconds ======")
+    print(f"====== Total Time: {total_elapse} milliseconds ======")
+    execution_times.append((app_id, "Power Start Time", power_start))
+    execution_times.append((app_id, "Power End Time", power_end))
+    execution_times.append((app_id, "Power Test Time", power_elapse))
+    execution_times.append((app_id, "Total Time", total_elapse))
+
+    header = ["application_id", "query", "time/milliseconds"]
+    with open(args.time_log, "w", encoding="UTF8", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(header)
+        w.writerows(execution_times)
+    if args.extra_time_log:
+        os.makedirs(os.path.dirname(args.extra_time_log) or ".",
+                    exist_ok=True)
+        with open(args.extra_time_log, "w", encoding="UTF8",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(header)
+            w.writerows(execution_times)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="NDS power run (TPU engine)")
+    p.add_argument("query_stream_file",
+                   help="query stream file (query_N.sql)")
+    p.add_argument("input_prefix", help="warehouse directory")
+    p.add_argument("time_log", help="per-query CSV time log output path")
+    p.add_argument("--input_format", default="parquet",
+                   choices=["parquet", "orc", "csv", "json", "ndslake"],
+                   help="warehouse table format")
+    p.add_argument("--engine", default="cpu", choices=["cpu", "tpu"],
+                   help="execution backend")
+    p.add_argument("--output_prefix",
+                   help="write per-query results under this dir "
+                        "(for validation); default = collect only")
+    p.add_argument("--output_format", default="parquet",
+                   choices=["parquet", "csv"])
+    p.add_argument("--property_file",
+                   help="engine properties file (knobs recorded in reports)")
+    p.add_argument("--json_summary_folder",
+                   help="folder for per-query JSON summaries")
+    p.add_argument("--sub_queries",
+                   help="comma-separated query-name subset, e.g. "
+                        "query1,query3_part1")
+    p.add_argument("--extra_time_log",
+                   help="secondary location for the CSV time log")
+    p.add_argument("--floats", action="store_true",
+                   help="double mode (no decimals)")
+    return p
+
+
+if __name__ == "__main__":
+    run_query_stream(build_parser().parse_args())

@@ -1,0 +1,84 @@
+"""Per-query benchmark report (JSON summary contract).
+
+Mirrors the reference's PysparkBenchReport (/root/reference/nds/PysparkBenchReport.py:42-122):
+captures env vars (TOKEN/SECRET/PASSWORD redacted), engine configuration and
+version, wall time, status taxonomy Completed / CompletedWithTaskFailures /
+Failed with exception strings, and writes `{prefix}-{query}-{startTime}.json`
+(the filename format is a downstream-pipeline contract).
+
+The reference's JVM task-failure listener maps here to an in-process warning
+collector: engine warnings during a query (e.g. schema coercion fallbacks)
+mark the run CompletedWithTaskFailures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+import warnings
+from typing import Callable
+
+import ndstpu
+
+
+class BenchReport:
+    """Wraps one measured callable; accumulates the JSON summary."""
+
+    def __init__(self, engine_conf: dict | None = None):
+        self.engine_conf = dict(engine_conf or {})
+        self.summary = {
+            "env": {
+                "envVars": {},
+                "engineConf": {},
+                "engineVersion": None,
+            },
+            "queryStatus": [],
+            "exceptions": [],
+            "taskFailures": [],
+            "startTime": None,
+            "queryTimes": [],
+        }
+
+    def report_on(self, fn: Callable, *args):
+        redacted = ("TOKEN", "SECRET", "PASSWORD")
+        self.summary["env"]["envVars"] = {
+            k: v for k, v in os.environ.items()
+            if not any(r in k.upper() for r in redacted)}
+        self.summary["env"]["engineConf"] = self.engine_conf
+        self.summary["env"]["engineVersion"] = ndstpu.__version__
+        start_time = int(time.time() * 1000)
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                fn(*args)
+            end_time = int(time.time() * 1000)
+            if caught:
+                self.summary["queryStatus"].append(
+                    "CompletedWithTaskFailures")
+                self.summary["taskFailures"].extend(
+                    str(w.message) for w in caught)
+            else:
+                self.summary["queryStatus"].append("Completed")
+        except Exception as e:  # noqa: BLE001 — benchmark must keep going
+            print("ERROR BEGIN")
+            print(e)
+            traceback.print_tb(e.__traceback__)
+            print("ERROR END")
+            end_time = int(time.time() * 1000)
+            self.summary["queryStatus"].append("Failed")
+            self.summary["exceptions"].append(str(e))
+        finally:
+            self.summary["startTime"] = start_time
+            self.summary["queryTimes"].append(end_time - start_time)
+        return self.summary
+
+    def write_summary(self, query_name: str, prefix: str = "") -> str:
+        self.summary["query"] = query_name
+        filename = (f"{prefix}-{query_name}-"
+                    f"{self.summary['startTime']}.json")
+        self.summary["filename"] = filename
+        with open(filename, "w") as f:
+            json.dump(self.summary, f, indent=2)
+        return filename

@@ -1,0 +1,39 @@
+"""Roll fact tables back to a pre-maintenance snapshot (nds_rollback analog).
+
+The reference calls Iceberg's `rollback_to_timestamp` on the 6 fact tables
+to undo data maintenance between repeated benchmark runs
+(/root/reference/nds/nds_rollback.py:37-59).  Here the same operation runs
+against the ndslake ACID tables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ndstpu.io import acid
+
+FACT_TABLES = ["store_sales", "store_returns", "catalog_sales",
+               "catalog_returns", "web_sales", "web_returns", "inventory"]
+
+
+def rollback(warehouse: str, timestamp: float,
+             tables=None) -> None:
+    for table in tables or FACT_TABLES:
+        root = os.path.join(warehouse, table)
+        if not acid.is_ndslake(root):
+            print(f"skip {table}: not an ndslake table")
+            continue
+        v = acid.rollback_to_timestamp(root, timestamp)
+        print(f"rolled back {table} to snapshot v{v}")
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("warehouse_path")
+    p.add_argument("timestamp", type=float,
+                   help="unix timestamp to roll back to")
+    p.add_argument("--tables", help="comma-separated subset")
+    a = p.parse_args()
+    rollback(a.warehouse_path, a.timestamp,
+             a.tables.split(",") if a.tables else None)

@@ -1,0 +1,48 @@
+"""Throughput test: N concurrent power runs (the `nds-throughput` analog).
+
+The reference fans out concurrent spark-submit processes with
+`xargs -d, -P<n> -I{}` substituting the stream id into the command
+(/root/reference/nds/nds-throughput:18-23).  Here each stream is one OS
+process running the power CLI with `{}` placeholders substituted the same
+way.
+
+    python -m ndstpu.harness.throughput 1,2,3 -- \\
+        python -m ndstpu.harness.power ./query_{}.sql ./wh ./time_{}.csv
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import List
+
+
+def run_throughput(stream_ids: List[str], cmd_template: List[str]) -> int:
+    procs = []
+    for sid in stream_ids:
+        cmd = [arg.replace("{}", sid) for arg in cmd_template]
+        print("launch:", " ".join(cmd))
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def main(argv: List[str]) -> int:
+    if "--" in argv:
+        sep = argv.index("--")
+        ids_arg, cmd = argv[:sep], argv[sep + 1:]
+    else:
+        ids_arg, cmd = argv[:1], argv[1:]
+    if not ids_arg or not cmd:
+        print("usage: throughput <id,id,...> -- <command with {} "
+              "placeholders>", file=sys.stderr)
+        return 2
+    stream_ids = [s for s in ids_arg[0].split(",") if s]
+    return run_throughput(stream_ids, cmd)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
