@@ -1,0 +1,95 @@
+"""Benchmark entry point: NDS power-run elapsed, TPU backend vs CPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Pipeline (mirrors the reference power run, nds/nds_power.py:183-304):
+generate raw data (cached) -> transcode to parquet warehouse (cached) ->
+render the query stream -> execute every query serially on the JAX/TPU
+backend (wall-clock around each result materialization), and on the
+numpy CPU reference interpreter as the baseline (the analog of the
+reference's power_run_cpu Spark path).
+
+value       = TPU-backend power-run elapsed seconds (warm, best of 2)
+vs_baseline = CPU elapsed / TPU elapsed  (>1 means TPU wins)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+CACHE = os.path.join(REPO, ".bench_cache")
+SF = float(os.environ.get("NDSTPU_BENCH_SF", "0.05"))
+
+
+def _ensure_warehouse() -> str:
+    tag = f"sf{SF}"
+    raw = os.path.join(CACHE, f"raw_{tag}")
+    wh = os.path.join(CACHE, f"wh_{tag}")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if not os.path.isdir(raw) or not os.listdir(raw):
+        os.makedirs(raw, exist_ok=True)
+        subprocess.run(
+            [sys.executable, "-m", "ndstpu.datagen.driver", "local",
+             str(SF), "2", raw],
+            check=True, env=env, stdout=subprocess.DEVNULL)
+    if not os.path.isdir(wh) or not os.listdir(wh):
+        os.makedirs(wh, exist_ok=True)
+        subprocess.run(
+            [sys.executable, "-m", "ndstpu.io.transcode",
+             "--input_prefix", raw, "--output_prefix", wh,
+             "--report_file", os.path.join(wh, "load.txt")],
+            check=True, env=env, stdout=subprocess.DEVNULL)
+    return wh
+
+
+def _power_run(sess, queries) -> float:
+    t0 = time.time()
+    for name, sql in queries:
+        out = sess.sql(sql)
+        # materialize like collect() (nds_power.py:124-134)
+        out.to_rows()
+    return time.time() - t0
+
+
+def main() -> None:
+    global SF
+    if "--quick" in sys.argv:
+        SF = min(SF, 0.01)
+    sys.path.insert(0, REPO)
+    wh = _ensure_warehouse()
+
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.queries import streamgen
+
+    queries = []
+    for tpl in streamgen.list_templates():
+        sql = streamgen.render_template(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
+        queries.append((tpl, sql))
+
+    catalog = loader.load_catalog(wh)
+    cpu_sess = Session(catalog, backend="cpu")
+    tpu_sess = Session(catalog, backend="tpu")
+
+    cpu_s = _power_run(cpu_sess, queries)
+    runs = [_power_run(tpu_sess, queries) for _ in range(2)]
+    tpu_s = min(runs)
+
+    print(json.dumps({
+        "metric": f"nds_power_run_elapsed_sf{SF}_"
+                  f"{len(queries)}q",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(cpu_s / tpu_s, 4) if tpu_s > 0 else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,8 +72,7 @@ class Session:
         if isinstance(stmt, ast.DropRel):
             self.views.pop(stmt.name, None)
             if stmt.kind == "table":
-                self.catalog.tables.pop(stmt.name, None)
-                self.catalog.meta.pop(stmt.name, None)
+                self.catalog.unregister(stmt.name)
             return None
         raise NotImplementedError(f"statement {type(stmt).__name__}")
 
@@ -91,7 +90,20 @@ class Session:
         return out
 
     def _execute(self, plan: lp.Plan) -> columnar.Table:
+        if self.backend == "tpu":
+            return self._jax_executor().execute_to_host(plan)
         return physical.execute(plan, self.catalog)
+
+    def _jax_executor(self):
+        """One JaxExecutor per session: keeps uploaded tables cached in HBM
+        across queries (analog of Spark's cached TempViews).  Per-table
+        invalidation happens inside the executor via catalog versions."""
+        from ndstpu.engine import jaxexec
+        exe = getattr(self, "_jax_exec_cache", None)
+        if exe is None or exe.catalog is not self.catalog:
+            exe = jaxexec.JaxExecutor(self.catalog)
+            self._jax_exec_cache = exe
+        return exe
 
     # -- DML against the warehouse (ACID ndslake tables) ---------------------
 

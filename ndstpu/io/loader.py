@@ -45,10 +45,14 @@ class Catalog:
 
     tables: Dict[str, columnar.Table] = field(default_factory=dict)
     meta: Dict[str, TableMeta] = field(default_factory=dict)
+    # per-table monotonic version, bumped on every (re)register — the
+    # invalidation key for device-resident caches (id() reuse is not sound)
+    versions: Dict[str, int] = field(default_factory=dict)
 
     def register(self, name: str, table: columnar.Table) -> None:
         self.tables[name] = table
         self.meta[name] = TableMeta(name, table.num_rows)
+        self.versions[name] = self.versions.get(name, 0) + 1
         key = _primary_key_column(name, table)
         if key is not None:
             col = table.column(key)
@@ -59,6 +63,11 @@ class Catalog:
                 if hi - lo + 1 == len(data) and _is_permutation(data, lo, hi):
                     self.meta[name].dense_key = key
                     self.meta[name].dense_min = lo
+
+    def unregister(self, name: str) -> None:
+        self.tables.pop(name, None)
+        self.meta.pop(name, None)
+        self.versions[name] = self.versions.get(name, 0) + 1
 
     def get(self, name: str) -> columnar.Table:
         return self.tables[name]

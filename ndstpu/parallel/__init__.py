@@ -1,0 +1,18 @@
+"""Distributed execution over a TPU device mesh.
+
+Replaces the reference's two distribution mechanisms (SURVEY.md §2
+parallelism table) with XLA collectives over ICI/DCN:
+
+* Spark shuffle exchange / broadcast joins (power_run_cpu.template:28-33)
+  -> ``all_to_all`` hash repartition and ``all_gather`` broadcast inside
+  ``shard_map`` programs (:mod:`ndstpu.parallel.exchange`).
+* Hadoop-MR fan-out of dsdgen chunks (GenTable.java:136-209)
+  -> per-host sharded generation (ndstpu.datagen driver --parallel).
+"""
+
+from ndstpu.parallel.mesh import default_mesh, make_mesh  # noqa: F401
+from ndstpu.parallel.exchange import (  # noqa: F401
+    broadcast_gather,
+    hash_repartition,
+    sharded_segment_sum,
+)

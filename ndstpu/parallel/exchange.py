@@ -1,0 +1,99 @@
+"""Shuffle-exchange primitives as XLA collectives inside ``shard_map``.
+
+The reference's comm backend is Spark's block shuffle + broadcast
+(SURVEY.md §5 "Distributed communication backend"); here the same three
+data-movement patterns are ICI/DCN collectives:
+
+* hash repartition (shuffle exchange)  -> ``lax.all_to_all``
+* broadcast join build side            -> ``lax.all_gather``
+* partial-aggregate combine            -> ``lax.psum``
+
+All functions are written to be called *inside* a ``shard_map`` body over
+the 1-D data axis (ndstpu.parallel.mesh.SHARD_AXIS), on per-shard local
+arrays, and are fully traceable (static bucket capacities).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ndstpu.parallel.mesh import SHARD_AXIS
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer — cheap, well-distributed bucket hash."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def hash_repartition(cols: Dict[str, jnp.ndarray], key: jnp.ndarray,
+                     alive: jnp.ndarray, n_dev: int, bucket_cap: int,
+                     axis: str = SHARD_AXIS
+                     ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray,
+                                jnp.ndarray]:
+    """Shuffle local rows so equal keys land on the same device.
+
+    Per-shard: bucket rows by ``hash(key) % n_dev`` into a [n_dev,
+    bucket_cap] send buffer, exchange buckets with ``all_to_all``.
+    Returns (local received columns of shape [n_dev * bucket_cap],
+    alive mask, global count of rows dropped for overflowing
+    ``bucket_cap``).  ``bucket_cap = rows_per_shard`` is always safe
+    (zero drops); smaller caps trade memory for a skew-overflow risk
+    the caller MUST check via the returned drop count.
+    """
+    n = key.shape[0]
+    dest = (_mix64(key) % jnp.uint64(n_dev)).astype(jnp.int32)
+    dest = jnp.where(alive, dest, n_dev)  # dead rows -> dropped bucket
+    order = jnp.argsort(dest, stable=True)
+    dsort = dest[order]
+    # rank within destination bucket
+    first = jnp.searchsorted(dsort, jnp.arange(n_dev + 1))
+    within = jnp.arange(n) - first[jnp.clip(dsort, 0, n_dev)]
+    ok = (within < bucket_cap) & (dsort < n_dev)
+    # dropped/overflow rows scatter into a dummy row that is sliced off
+    # (duplicate-index scatter order is undefined, so they must never
+    # alias a real slot)
+    row = jnp.where(ok, jnp.clip(dsort, 0, n_dev - 1), n_dev)
+    slot = jnp.clip(within, 0, bucket_cap - 1)
+
+    def scatter(arr: jnp.ndarray) -> jnp.ndarray:
+        buf = jnp.zeros((n_dev + 1, bucket_cap), arr.dtype)
+        return buf.at[row, slot].set(arr[order])[:n_dev]
+
+    sent_alive = jnp.zeros((n_dev + 1, bucket_cap), bool).at[
+        row, slot].set(ok)[:n_dev]
+    n_dropped = lax.psum(
+        jnp.sum(((within >= bucket_cap) & (dsort < n_dev))
+                .astype(jnp.int64)), axis)
+    out_cols = {}
+    for name, arr in cols.items():
+        buf = scatter(arr)
+        got = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)
+        out_cols[name] = got.reshape(n_dev * bucket_cap)
+    alive_out = lax.all_to_all(sent_alive, axis, split_axis=0,
+                               concat_axis=0).reshape(n_dev * bucket_cap)
+    return out_cols, alive_out, n_dropped
+
+
+def broadcast_gather(arr: jnp.ndarray, axis: str = SHARD_AXIS
+                     ) -> jnp.ndarray:
+    """Replicate all shards' rows on every device (broadcast join build
+    side; analog of spark.sql.autoBroadcastJoinThreshold exchange)."""
+    return lax.all_gather(arr, axis, tiled=True)
+
+
+def sharded_segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
+                        num_segments: int, axis: str = SHARD_AXIS
+                        ) -> jnp.ndarray:
+    """Partial aggregation: local segment_sum, then cross-device psum.
+    The group-key -> segment-id mapping must be device-agnostic (e.g. a
+    dense dimension key), so partials line up slot-for-slot."""
+    partial = jax.ops.segment_sum(values, segment_ids,
+                                  num_segments=num_segments)
+    return lax.psum(partial, axis)

@@ -124,26 +124,32 @@ def generate_query_streams(template_dir: Optional[str], rngseed: str,
 
 def generate_single_template(template: str, template_dir: Optional[str],
                              rngseed: str, output_dir: str) -> List[str]:
-    """Render one template (test mode).  Multi-statement templates are split
-    into _part1/_part2 files like the reference (nds_gen_query_stream.py:91-103)."""
+    """Render one template (test mode) as a one-query stream file
+    `query_0.sql` WITH start/end markers — dsqgen emits the spark.tpl
+    markers in single-template mode too, and the power runner's parser
+    requires them (reference nds_gen_query_stream.py:57-89,
+    nds_power.py:49-76).  Multi-statement templates additionally produce
+    split _part1/_part2 files (nds_gen_query_stream.py:91-103)."""
     os.makedirs(output_dir, exist_ok=True)
     d = Path(template_dir) if template_dir else TEMPLATE_DIR
     name = template if template.endswith(".tpl") else template + ".tpl"
     sql = render_template(str(d / name), rngseed, 0)
+    if not sql.rstrip().endswith(";"):
+        sql = sql.rstrip() + "\n;"
+    stream_path = os.path.join(output_dir, "query_0.sql")
+    with open(stream_path, "w") as f:
+        f.write(f"-- start query 1 in stream 0 using template {name}\n"
+                f"{sql}\n"
+                f"-- end query 1 in stream 0 using template {name}\n")
+    out_paths = [stream_path]
     stmts = [s.strip() for s in sql.split(";") if s.strip()]
     base = name[:-4]
-    out_paths = []
     if len(stmts) > 1:
         for k, stmt in enumerate(stmts, 1):
             p = os.path.join(output_dir, f"{base}_part{k}.sql")
             with open(p, "w") as f:
                 f.write(stmt + ";\n")
             out_paths.append(p)
-    else:
-        p = os.path.join(output_dir, f"{base}.sql")
-        with open(p, "w") as f:
-            f.write(stmts[0] + ";\n")
-        out_paths.append(p)
     return out_paths
 
 

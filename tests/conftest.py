@@ -1,7 +1,9 @@
 """Test configuration: run JAX on a virtual 8-device CPU mesh.
 
-Must set env vars before jax is imported anywhere, so this executes at
-conftest import time (pytest loads conftest before test modules).
+jax may already be imported by the interpreter's sitecustomize (axon
+PJRT), so env vars alone are too late — set XLA_FLAGS for the host
+platform and switch the platform via jax.config before any backend is
+initialized (pytest loads conftest before test modules).
 """
 
 import os
@@ -12,6 +14,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

@@ -88,7 +88,14 @@ def test_param_substitution_differs_across_streams(tmp_path):
 
 
 def test_single_template_mode(tmp_path):
+    # single-template mode emits a one-query stream file with the marker
+    # contract the power runner parses (reference nds_power.py:49-76)
     out = streamgen.generate_single_template("query3", None, "1",
                                              str(tmp_path))
-    assert len(out) == 1 and out[0].endswith("query3.sql")
-    assert open(out[0]).read().rstrip().endswith(";")
+    assert len(out) == 1 and out[0].endswith("query_0.sql")
+    text = open(out[0]).read()
+    assert "-- start query 1 in stream 0 using template query3.tpl" in text
+    assert "-- end query 1 in stream 0" in text
+    from ndstpu.harness.power import gen_sql_from_stream
+    qd = gen_sql_from_stream(out[0])
+    assert list(qd) == ["query3"]
