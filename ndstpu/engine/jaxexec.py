@@ -147,6 +147,29 @@ def _pad(arr: np.ndarray, cap: int, fill=0) -> np.ndarray:
     return out
 
 
+import contextlib  # noqa: E402
+
+
+def host_cpu_device():
+    """The host CPU jax device, if one is registered alongside an
+    accelerator platform (None when CPU already is the default)."""
+    try:
+        dev = jax.devices("cpu")[0]
+    except Exception:
+        return None
+    return dev if jax.devices()[0] != dev else None
+
+
+def host_compute():
+    """Context manager pinning uncommitted jax computation to the host
+    CPU backend.  The eager/discovery path runs under it — per-primitive
+    dispatch to a remote accelerator would cost a round-trip each; only
+    compiled replay programs run on the accelerator."""
+    dev = host_cpu_device()
+    return jax.default_device(dev) if dev is not None else \
+        contextlib.nullcontext()
+
+
 def to_device(t: Table, cap: Optional[int] = None) -> DTable:
     n = t.num_rows
     cap = cap or size_class(n)
@@ -754,13 +777,38 @@ def _dense_rank_pair(a: jnp.ndarray, b: jnp.ndarray):
 
 
 class JaxExecutor:
-    """Plan executor on the JAX backend, with per-subtree numpy fallback."""
+    """Plan executor on the JAX backend, with per-subtree numpy fallback.
+
+    Three modes share one operator implementation:
+
+    * ``eager``    — ops dispatch immediately (correctness path).
+    * ``discover`` — like eager, but records every data-dependent decision
+      (output capacities at join/compact sync points, null-aware branch
+      bools, resolved subquery literals) into a *size plan*.
+    * ``replay``   — re-runs the plan under ``jax.jit`` tracing: recorded
+      capacities become static shapes, recorded branches drive control
+      flow, and each decision contributes a traced ``ok`` guard; the
+      whole query becomes ONE XLA program (critical on real TPUs, where
+      eager dispatch costs a host round-trip per primitive).
+
+    If the guards fail at runtime (data changed enough to overflow a
+    size class), the caller re-discovers and recompiles.
+    """
 
     def __init__(self, catalog):
         self.catalog = catalog
         self.np_exec = physical.Executor(catalog)
         self._device_cache: Dict[str, Tuple[int, DTable]] = {}
+        self._accel_cache: Dict[str, Tuple[int, object]] = {}
         self._subq_cache: Dict[int, ex.Expr] = {}
+        self.mode = "eager"
+        self._rec: Optional[list] = None   # size plan being written/read
+        self._pos = 0
+        self._oks: Optional[list] = None   # traced guard bools (replay)
+        self._trace_tables: Optional[Dict[str, DTable]] = None
+        self._used_fallback = False
+        # compiled-query cache: plan identity -> _CompiledPlan
+        self._compiled: Dict[int, "_CompiledPlan"] = {}
 
     # -- public --------------------------------------------------------------
 
@@ -768,7 +816,45 @@ class JaxExecutor:
         # per-query subquery memo: expr ids are only stable within one plan
         self._subq_cache = {}
         self.np_exec = physical.Executor(self.catalog)
-        return to_host(self.execute(p))
+        self.mode = "eager"
+        with host_compute():
+            return to_host(self.execute(p))
+
+    # -- sync-point abstraction ----------------------------------------------
+
+    def _capacity_for(self, count) -> Tuple[int, jnp.ndarray]:
+        """Size-class a data-dependent output count.
+
+        eager/discover: host-sync the count, compute the size class
+        (discover records it).  replay: pop the recorded capacity (static)
+        and guard ``count <= cap``; the traced count still drives alive
+        masks, so results stay exact as long as the guard holds."""
+        if self.mode == "replay":
+            tag, cap = self._rec[self._pos]
+            self._pos += 1
+            if tag != "cap":
+                raise RuntimeError("size-plan drift (expected cap)")
+            self._oks.append(count <= cap)
+            return cap, count
+        n = int(count)
+        cap = size_class(max(n, 1))
+        if self.mode == "discover":
+            self._rec.append(("cap", cap))
+        return cap, count
+
+    def _branch_bool(self, flag) -> bool:
+        """Host-sync a branch decision (replay: recorded + guarded)."""
+        if self.mode == "replay":
+            tag, val = self._rec[self._pos]
+            self._pos += 1
+            if tag != "bool":
+                raise RuntimeError("size-plan drift (expected bool)")
+            self._oks.append(jnp.asarray(flag) == val)
+            return val
+        b = bool(flag)
+        if self.mode == "discover":
+            self._rec.append(("bool", b))
+        return b
 
     def execute(self, p: lp.Plan) -> DTable:
         name = "_exec_" + type(p).__name__.lower()
@@ -785,6 +871,11 @@ class JaxExecutor:
     def _fallback(self, p: lp.Plan) -> DTable:
         """Run this node on the numpy interpreter; children still execute on
         the device path and are pulled to host once."""
+        if self.mode == "replay":
+            raise RuntimeError(
+                f"fallback for {type(p).__name__} during replay — "
+                "discovery should have marked this plan non-compilable")
+        self._used_fallback = True
         repl = self._replace_children_with_host(p)
         host = self.np_exec.execute(repl)
         return to_device(host)
@@ -817,27 +908,51 @@ class JaxExecutor:
         if isinstance(e, ex.SubqueryExpr):
             if id(e) in self._subq_cache:
                 return self._subq_cache[id(e)]
-            t = to_host(self.execute(e.plan))
-            col = t.columns[t.column_names[0]]
-            if e.kind == "scalar":
-                if t.num_rows == 0:
-                    out = ex.Literal(None, col.ctype)
+            if self.mode == "replay":
+                # subquery results were resolved during discovery and are
+                # part of the size plan (guarded by catalog versions)
+                tag, out = self._rec[self._pos]
+                self._pos += 1
+                if tag != "subq":
+                    raise RuntimeError("size-plan drift (expected subq)")
+                self._subq_cache[id(e)] = out
+                return out
+            # the sub-plan executes eagerly even during discovery so its
+            # own sync points never leak into the main plan's size plan
+            # (replay skips the sub-plan entirely — a fallback inside it
+            # doesn't make the main plan non-compilable either)
+            outer = self.mode
+            outer_fallback = self._used_fallback
+            self.mode = "eager"
+            try:
+                t = to_host(self.execute(e.plan))
+                col = t.columns[t.column_names[0]]
+                if e.kind == "scalar":
+                    if t.num_rows == 0:
+                        out = ex.Literal(None, col.ctype)
+                    else:
+                        vals = col.to_pylist()
+                        if len(vals) > 1:
+                            raise RuntimeError(
+                                "scalar subquery returned >1 row")
+                        out = ex.Literal(vals[0], col.ctype)
+                elif e.kind == "in":
+                    pyvals = col.to_pylist()
+                    has_null = any(v is None for v in pyvals)
+                    vals = tuple(v for v in pyvals if v is not None)
+                    if e.negated and has_null:
+                        out = ex.Literal(False)
+                    else:
+                        out = ex.InList(
+                            self._resolve_subqueries(e.operand), vals,
+                            e.negated)
                 else:
-                    vals = col.to_pylist()
-                    if len(vals) > 1:
-                        raise RuntimeError("scalar subquery returned >1 row")
-                    out = ex.Literal(vals[0], col.ctype)
-            elif e.kind == "in":
-                pyvals = col.to_pylist()
-                has_null = any(v is None for v in pyvals)
-                vals = tuple(v for v in pyvals if v is not None)
-                if e.negated and has_null:
-                    out = ex.Literal(False)
-                else:
-                    out = ex.InList(self._resolve_subqueries(e.operand),
-                                    vals, e.negated)
-            else:
-                raise Unsupported(f"subquery kind {e.kind}")
+                    raise Unsupported(f"subquery kind {e.kind}")
+            finally:
+                self.mode = outer
+                self._used_fallback = outer_fallback
+            if self.mode == "discover":
+                self._rec.append(("subq", out))
             self._subq_cache[id(e)] = out
             return out
         if isinstance(e, ex.BinOp):
@@ -863,18 +978,24 @@ class JaxExecutor:
 
     # -- leaves --------------------------------------------------------------
 
-    def _exec_scan(self, p: lp.Scan) -> DTable:
-        host = self.catalog.get(p.table)
-        version = getattr(self.catalog, "versions", {}).get(p.table)
-        cached = self._device_cache.get(p.table)
+    def _table_device(self, name: str) -> DTable:
+        host = self.catalog.get(name)
+        version = getattr(self.catalog, "versions", {}).get(name)
+        cached = self._device_cache.get(name)
         if cached is not None and cached[0] == version and \
                 version is not None:
-            dt = cached[1]
+            return cached[1]
+        dt = to_device(host)
+        self._device_cache[name] = (version, dt)
+        return dt
+
+    def _exec_scan(self, p: lp.Scan) -> DTable:
+        if self.mode == "replay":
+            dt = self._trace_tables[p.table]
         else:
-            dt = to_device(host)
-            self._device_cache[p.table] = (version, dt)
+            dt = self._table_device(p.table)
         if p.columns is not None:
-            cols = list(p.columns) or host.column_names[:1]
+            cols = list(p.columns) or dt.column_names[:1]
             dt = dt.select(cols)
         if p.predicate is not None:
             pred = self._resolve_subqueries(p.predicate)
@@ -915,10 +1036,9 @@ class JaxExecutor:
         return DTable(dt.columns, dt.alive & keep)
 
     def compact(self, dt: DTable) -> DTable:
-        """Scatter alive rows to the front (order-preserving); one host
-        sync for the new capacity."""
-        n_alive = int(jnp.sum(dt.alive))
-        cap = size_class(n_alive)
+        """Scatter alive rows to the front (order-preserving); one
+        sync point for the new capacity."""
+        cap, n_alive = self._capacity_for(jnp.sum(dt.alive))
         idx_src = jnp.nonzero(dt.alive, size=cap, fill_value=0)[0]
         alive = jnp.arange(cap) < n_alive
         cols = {n: DCol(c.data[idx_src], c.valid[idx_src] & alive,
@@ -961,15 +1081,34 @@ class JaxExecutor:
     # -- aggregate -----------------------------------------------------------
 
     def _exec_aggregate(self, p: lp.Aggregate) -> DTable:
-        if p.grouping_sets is not None:
-            raise Unsupported("grouping sets on device")
         for _, e in p.aggs:
             self._check_agg_supported(e)
         dt = self.execute(p.child)
+        if p.grouping_sets is None:
+            return self._aggregate_once(dt, p, None)
+        parts = [self._aggregate_once(dt, p, subset)
+                 for subset in p.grouping_sets]
+        cols: Dict[str, DCol] = {}
+        for n in parts[0].column_names:
+            cs = [t.columns[n] for t in parts]
+            cols[n] = DCol(jnp.concatenate([c.data for c in cs]),
+                           jnp.concatenate([c.valid for c in cs]),
+                           cs[0].ctype, cs[0].dictionary)
+        return DTable(cols, jnp.concatenate([t.alive for t in parts]))
+
+    def _aggregate_once(self, dt: DTable, p: lp.Aggregate,
+                        subset: Optional[List[int]]) -> DTable:
         evl = JEval(dt)
         cap = dt.capacity
-        key_cols = [(name, evl.eval(self._resolve_subqueries(e)))
-                    for name, e in p.group_by]
+        key_cols = []
+        for i, (name, e) in enumerate(p.group_by):
+            c = evl.eval(self._resolve_subqueries(e))
+            if subset is not None and i not in subset:
+                # excluded key in this grouping set -> all NULL (rollup)
+                c = DCol(jnp.zeros_like(c.data), jnp.zeros(cap, bool),
+                         c.ctype, c.dictionary)
+            key_cols.append((name, c))
+        self._grouping_ctx = ([n for n, _ in p.group_by], subset)
         if key_cols:
             keys = [_key_i64(c, dt.alive) for _, c in key_cols]
             gid, order, newgrp = _group_ids(keys)
@@ -1006,13 +1145,21 @@ class JaxExecutor:
                                      "stddev_samp", "var_samp", "stddev",
                                      "variance"):
                     raise Unsupported(f"aggregate {node.func}")
-            if isinstance(node, ex.Func) and node.name == "grouping":
-                raise Unsupported("grouping() on device")
 
     def _eval_agg(self, dt: DTable, evl: JEval, e: ex.Expr, gid, ngseg,
                   out_alive) -> DCol:
         if isinstance(e, ex.AggExpr):
             return self._agg_column(dt, evl, e, gid, ngseg, out_alive)
+        if isinstance(e, ex.Func) and e.name == "grouping":
+            # grouping(key) = 0 when the key participates in this grouping
+            # set, 1 when rolled up (Spark semantics)
+            names, subset = self._grouping_ctx
+            arg = e.args[0]
+            idx = names.index(arg.name) if isinstance(
+                arg, ex.ColumnRef) and arg.name in names else -1
+            active = subset is None or idx in subset
+            return DCol(jnp.full(ngseg, 0 if active else 1, jnp.int32),
+                        jnp.ones(ngseg, bool), INT32)
         if isinstance(e, (ex.BinOp, ex.Cast, ex.Func, ex.Case, ex.Literal)):
             # expression over aggregates: evaluate leaves then combine on
             # the group-capacity table
@@ -1024,6 +1171,12 @@ class JaxExecutor:
                     name = f"__agg{counter[0]}"
                     counter[0] += 1
                     sub_cols[name] = self._agg_column(
+                        dt, evl, node, gid, ngseg, out_alive)
+                    return ex.ColumnRef(name)
+                if isinstance(node, ex.Func) and node.name == "grouping":
+                    name = f"__agg{counter[0]}"
+                    counter[0] += 1
+                    sub_cols[name] = self._eval_agg(
                         dt, evl, node, gid, ngseg, out_alive)
                     return ex.ColumnRef(name)
                 if isinstance(node, ex.BinOp):
@@ -1115,10 +1268,118 @@ class JaxExecutor:
             return DCol(data, ok, FLOAT64)
         raise Unsupported(f"aggregate {func}")
 
+    # -- window --------------------------------------------------------------
+
+    def _exec_window(self, p: lp.Window) -> DTable:
+        dt = self.execute(p.child)
+        out = dict(dt.columns)
+        for name, e in p.exprs:
+            if not isinstance(e, ex.WindowExpr):
+                raise Unsupported("non-window expr in Window node")
+            out[name] = self._window_column(dt, e)
+        return DTable(out, dt.alive)
+
+    def _window_column(self, dt: DTable, w: ex.WindowExpr) -> DCol:
+        cap = dt.capacity
+        evl = JEval(dt)
+        if w.partition_by:
+            pcols = [evl.eval(self._resolve_subqueries(e))
+                     for e in w.partition_by]
+            pkeys = [_key_i64(c, dt.alive) for c in pcols]
+        else:
+            pkeys = [jnp.where(dt.alive, jnp.int64(0), _DEAD_KEY)]
+        pid, _, _ = _group_ids(pkeys)
+        if w.func in ("row_number", "rank", "dense_rank"):
+            okeys = []
+            for e, asc in w.order_by:
+                c = evl.eval(self._resolve_subqueries(e))
+                okeys.append(self._order_key(evl, c, asc, None))
+            order = _lexsort_order([pid.astype(jnp.int64)] + okeys)
+            idx = jnp.arange(cap)
+            pid_s = pid[order]
+            newpart = jnp.ones(cap, bool)
+            if cap > 1:
+                newpart = newpart.at[1:].set(pid_s[1:] != pid_s[:-1])
+            part_start = jax.lax.cummax(jnp.where(newpart, idx, 0))
+            pos_in_part = idx - part_start
+            inv = jnp.zeros(cap, jnp.int64).at[order].set(idx)
+            if w.func == "row_number":
+                return DCol((pos_in_part + 1)[inv].astype(jnp.int64),
+                            jnp.ones(cap, bool), INT64)
+            tie = jnp.zeros(cap, bool)
+            if cap > 1:
+                t = jnp.ones(cap - 1, bool)
+                for k in okeys:
+                    ks = k[order]
+                    t = t & (ks[1:] == ks[:-1])
+                tie = tie.at[1:].set(t & ~newpart[1:])
+            if w.func == "rank":
+                last_nontie = jax.lax.cummax(jnp.where(~tie, idx, 0))
+                ranks = pos_in_part[last_nontie] + 1
+            else:
+                incr = jnp.where(newpart, 0, (~tie).astype(jnp.int64))
+                csum = jnp.cumsum(incr)
+                base = jax.lax.cummax(jnp.where(newpart, csum, 0))
+                ranks = csum - base + 1
+            return DCol(ranks[inv].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
+        # aggregate window over the whole partition (no frames)
+        gid = pid
+        if w.func == "count" and (w.arg is None or
+                                  isinstance(w.arg, ex.Star)):
+            cnt = jax.ops.segment_sum(dt.alive.astype(jnp.int64), gid,
+                                      num_segments=cap)
+            return DCol(cnt[gid], jnp.ones(cap, bool), INT64)
+        arg = evl.eval(self._resolve_subqueries(w.arg))
+        valid = arg.valid & dt.alive
+        cnts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                   num_segments=cap)
+        got = (cnts > 0)[gid]
+        if w.func == "count":
+            return DCol(jax.ops.segment_sum(
+                valid.astype(jnp.int64), gid, num_segments=cap)[gid],
+                jnp.ones(cap, bool), INT64)
+        if w.func == "sum":
+            if arg.ctype.kind in ("decimal", "int32", "int64"):
+                vals = jnp.where(valid, arg.data.astype(jnp.int64), 0)
+                tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
+                ct = decimal(38, arg.ctype.scale) \
+                    if arg.ctype.kind == "decimal" else INT64
+                return DCol(tot[gid], got, ct)
+            vals = jnp.where(valid, arg.data.astype(jnp.float64), 0.0)
+            tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
+            return DCol(tot[gid], got, FLOAT64)
+        if w.func == "avg":
+            vals = jnp.where(valid, arg.data.astype(jnp.float64), 0.0)
+            tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
+            mean = tot / jnp.maximum(cnts, 1)
+            if arg.ctype.kind == "decimal":
+                mean = mean / (10 ** arg.ctype.scale)
+            return DCol(mean[gid], got, FLOAT64)
+        if w.func in ("min", "max"):
+            if arg.ctype.kind == "float64":
+                init = jnp.inf if w.func == "min" else -jnp.inf
+                vals = jnp.where(valid, arg.data, init)
+                seg = (jax.ops.segment_min if w.func == "min"
+                       else jax.ops.segment_max)
+                return DCol(seg(vals, gid, num_segments=cap)[gid], got,
+                            arg.ctype)
+            data64 = arg.data.astype(jnp.int64)
+            init = _DEAD_KEY if w.func == "min" else -_DEAD_KEY
+            vals = jnp.where(valid, data64, init)
+            seg = (jax.ops.segment_min if w.func == "min"
+                   else jax.ops.segment_max)
+            out = seg(vals, gid, num_segments=cap)[gid]
+            return DCol(out.astype(arg.data.dtype), got, arg.ctype,
+                        arg.dictionary)
+        raise Unsupported(f"window {w.func}")
+
     # -- distinct ------------------------------------------------------------
 
     def _exec_distinct(self, p: lp.Distinct) -> DTable:
-        dt = self.execute(p.child)
+        return self._distinct_of(self.execute(p.child))
+
+    def _distinct_of(self, dt: DTable) -> DTable:
         for c in dt.columns.values():
             if c.ctype.kind not in ("int32", "int64", "decimal", "date",
                                     "string", "bool", "float64"):
@@ -1140,13 +1401,36 @@ class JaxExecutor:
     # -- set ops -------------------------------------------------------------
 
     def _exec_setop(self, p: lp.SetOp) -> DTable:
-        if p.kind != "union" or not p.all:
-            raise Unsupported("set op on device")
         lt = self.execute(p.left)
         rt = self.execute(p.right)
         rt = DTable(dict(zip(lt.column_names, rt.columns.values())),
                     rt.alive)
-        capl, capr = lt.capacity, rt.capacity
+        both = self._vconcat(lt, rt)
+        if p.kind == "union":
+            return both if p.all else self._distinct_of(both)
+        # intersect / except, distinct semantics (Spark): keep the first
+        # left occurrence of each qualifying row-value group
+        cap = both.capacity
+        nl = lt.capacity
+        keys = [_key_i64(c, both.alive) for c in both.columns.values()]
+        gid, order, newgrp = _group_ids(keys)
+        pos = jnp.arange(cap)
+        is_left = pos < nl
+        in_left = jax.ops.segment_sum(
+            (both.alive & is_left).astype(jnp.int32), gid,
+            num_segments=cap) > 0
+        in_right = jax.ops.segment_sum(
+            (both.alive & ~is_left).astype(jnp.int32), gid,
+            num_segments=cap) > 0
+        keepg = (in_left & in_right) if p.kind == "intersect" else \
+            (in_left & ~in_right)
+        lidx = jnp.where(both.alive & is_left, pos, cap)
+        firstl = jnp.full(cap, cap, jnp.int64).at[gid].min(lidx)
+        keep = (firstl[gid] == pos) & keepg[gid] & both.alive & is_left
+        return DTable(both.columns, keep)
+
+    def _vconcat(self, lt: DTable, rt: DTable) -> DTable:
+        """Vertical concat with dictionary merge / numeric unification."""
         cols: Dict[str, DCol] = {}
         for n in lt.column_names:
             lc, rc = lt.column(n), rt.column(n)
@@ -1212,17 +1496,77 @@ class JaxExecutor:
 
     def _exec_join(self, p: lp.Join) -> DTable:
         kind = p.kind
-        if kind in ("cross", "right", "full") or not p.keys:
-            raise Unsupported(f"{kind or 'non-equi'} join on device")
         lt = self.execute(p.left)
         rt = self.execute(p.right)
+        extra = self._resolve_subqueries(p.extra) \
+            if p.extra is not None else None
+        if kind == "cross" or not p.keys:
+            if kind not in ("cross", "inner"):
+                raise Unsupported(f"non-equi {kind} join")
+            return self._cross_join(lt, rt, extra)
+        if kind == "right":
+            out = self._equi_join(rt, lt,
+                                  [(r, l) for l, r in p.keys], "left",
+                                  extra)
+            return out.select(list(lt.columns) + list(rt.columns))
+        if kind == "full":
+            return self._full_join(lt, rt, p.keys, extra)
+        return self._equi_join(lt, rt, p.keys, kind, extra)
+
+    def _cross_join(self, lt: DTable, rt: DTable, extra) -> DTable:
+        ltc = self.compact(lt)
+        rtc = self.compact(rt)
+        nl = jnp.sum(ltc.alive)
+        nr = jnp.sum(rtc.alive)
+        out_cap, total = self._capacity_for(nl * nr)
+        pos = jnp.arange(out_cap)
+        nr_safe = jnp.maximum(nr, 1)
+        li = jnp.minimum(pos // nr_safe, ltc.capacity - 1)
+        ri = jnp.minimum(pos % nr_safe, rtc.capacity - 1)
+        alive = pos < total
+        lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
+                         c.dictionary) for n, c in ltc.columns.items()}
+        rcols = {n: DCol(c.data[ri], c.valid[ri] & alive, c.ctype,
+                         c.dictionary) for n, c in rtc.columns.items()}
+        out = DTable({**lcols, **rcols}, alive)
+        if extra is not None:
+            mask = JEval(out).predicate(extra)
+            out = DTable(out.columns, out.alive & mask)
+        return out
+
+    def _full_join(self, lt: DTable, rt: DTable, keys, extra) -> DTable:
+        left_part = self._equi_join(lt, rt, keys, "left", extra)
+        # right rows with no key match (residual predicate excluded, as in
+        # the reference interpreter's full-join path)
+        lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
+        lkey = jnp.where(lvalid & lt.alive, lkey, jnp.int64(-1))
+        rkey = jnp.where(rvalid & rt.alive, rkey, jnp.int64(-2))
+        lorder = jnp.argsort(lkey, stable=True)
+        lsorted = lkey[lorder]
+        rmatched = jnp.searchsorted(lsorted, rkey, side="left") != \
+            jnp.searchsorted(lsorted, rkey, side="right")
+        runmatched = rt.alive & ~rmatched
+        # bottom block: null left columns + unmatched right rows
+        bottom_cols: Dict[str, DCol] = {}
+        for n, c in lt.columns.items():
+            bottom_cols[n] = DCol(jnp.zeros_like(c.data),
+                                  jnp.zeros(rt.capacity, bool), c.ctype,
+                                  c.dictionary)
+        for n, c in rt.columns.items():
+            bottom_cols[n] = DCol(c.data, c.valid & runmatched, c.ctype,
+                                  c.dictionary)
+        bottom = DTable(bottom_cols, runmatched)
+        return self._vconcat(left_part, bottom)
+
+    def _equi_join(self, lt: DTable, rt: DTable, keys, kind,
+                   extra) -> DTable:
         if lt.capacity * rt.capacity > 2 ** 48:
             raise Unsupported("join too large for rank pairing")
-        lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, p.keys)
+        lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
 
         if kind == "nullaware_anti":
-            rt_has_null = bool(jnp.any(~rvalid & rt.alive))
-            rt_nonempty = bool(jnp.any(rt.alive))
+            rt_has_null = self._branch_bool(jnp.any(~rvalid & rt.alive))
+            rt_nonempty = self._branch_bool(jnp.any(rt.alive))
             if rt_has_null:
                 return DTable(lt.columns, jnp.zeros(lt.capacity, bool))
             kind = "anti"
@@ -1241,28 +1585,40 @@ class JaxExecutor:
         matched = counts > 0
 
         if kind in ("semi", "anti"):
-            if p.extra is not None:
-                raise Unsupported("residual predicate on semi/anti")
+            if extra is not None:
+                # expand matches, apply the residual, mark left rows with
+                # surviving matches
+                out_cap, total = self._capacity_for(jnp.sum(counts))
+                inner = self._expand(lt, rt, order, lo, counts, total,
+                                     out_cap)
+                keep = JEval(inner).predicate(extra)
+                li_all = jnp.searchsorted(jnp.cumsum(counts),
+                                          jnp.arange(out_cap),
+                                          side="right")
+                li_all = jnp.clip(li_all, 0, lt.capacity - 1)
+                hits = jax.ops.segment_sum(
+                    keep.astype(jnp.int32), li_all,
+                    num_segments=lt.capacity) > 0
+                mask = hits if kind == "semi" else ~hits
+                return DTable(lt.columns, lt.alive & mask)
             mask = matched if kind == "semi" else \
                 (~matched & lt.alive)
             return DTable(lt.columns, lt.alive & mask)
 
-        # inner/left expansion: one host sync for output capacity
-        total = int(jnp.sum(counts))
+        # inner/left expansion: one sync point for output capacity
         if kind == "inner":
-            out_cap = size_class(max(total, 1))
+            out_cap, total = self._capacity_for(jnp.sum(counts))
             out = self._expand(lt, rt, order, lo, counts, total, out_cap)
-            if p.extra is not None:
-                extra = self._resolve_subqueries(p.extra)
+            if extra is not None:
                 mask = JEval(out).predicate(extra)
                 out = DTable(out.columns, out.alive & mask)
             return out
         if kind == "left":
-            return self._left_join(lt, rt, order, lo, counts, total, p)
+            return self._left_join(lt, rt, order, lo, counts, extra)
         raise Unsupported(f"join kind {kind}")
 
     def _expand(self, lt: DTable, rt: DTable, order, lo, counts,
-                total: int, out_cap: int) -> DTable:
+                total, out_cap: int) -> DTable:
         ccounts = jnp.cumsum(counts)
         pos = jnp.arange(out_cap)
         li = jnp.searchsorted(ccounts, pos, side="right")
@@ -1279,15 +1635,14 @@ class JaxExecutor:
         return DTable({**lcols, **rcols}, alive)
 
     def _left_join(self, lt: DTable, rt: DTable, order, lo, counts,
-                   total: int, p: lp.Join) -> DTable:
-        matched_cap = size_class(max(total, 1))
+                   extra) -> DTable:
+        matched_cap, total = self._capacity_for(jnp.sum(counts))
         inner = self._expand(lt, rt, order, lo, counts, total, matched_cap)
         # left-row index feeding each inner output position
         li_all = jnp.searchsorted(jnp.cumsum(counts),
                                   jnp.arange(matched_cap), side="right")
         li_all = jnp.clip(li_all, 0, lt.capacity - 1)
-        if p.extra is not None:
-            extra = self._resolve_subqueries(p.extra)
+        if extra is not None:
             keep = JEval(inner).predicate(extra)
             inner = DTable(inner.columns, keep)
         # left rows that kept >=1 match after the residual predicate
@@ -1295,9 +1650,9 @@ class JaxExecutor:
                                    num_segments=lt.capacity)
         unmatched_mask = lt.alive & (hits == 0)
         inner_c = self.compact(inner)
-        n_matched = int(jnp.sum(inner_c.alive))
-        n_unmatched = int(jnp.sum(unmatched_mask))
-        out_cap = size_class(max(n_matched + n_unmatched, 1))
+        n_matched = jnp.sum(inner_c.alive)
+        n_unmatched = jnp.sum(unmatched_mask)
+        out_cap, _ = self._capacity_for(n_matched + n_unmatched)
         # out[pos] = matched[pos] for pos < n_matched,
         #            unmatched-left[pos - n_matched] after (null right side)
         pos = jnp.arange(out_cap)
@@ -1318,6 +1673,148 @@ class JaxExecutor:
             valid = jnp.where(is_m, mc.valid[mi], False) & out_alive
             cols[n] = DCol(mc.data[mi], valid, mc.ctype, mc.dictionary)
         return DTable(cols, out_alive)
+
+
+@dataclasses.dataclass
+class _CompiledPlan:
+    plan: lp.Plan
+    compilable: bool
+    record: list
+    versions: tuple
+    # per-table column subset actually scanned (None = all columns)
+    table_cols: Dict[str, Optional[List[str]]] = None
+    fn: object = None                    # jitted replay function
+    out_meta: List[tuple] = None         # (name, ctype, dictionary)
+
+
+def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
+    """Union of scanned columns per table (None = full table)."""
+    out: Dict[str, Optional[List[str]]] = {}
+    for node in p.walk():
+        if isinstance(node, lp.Scan):
+            if node.columns is None:
+                out[node.table] = None
+            elif node.table not in out:
+                out[node.table] = list(node.columns)
+            elif out[node.table] is not None:
+                for c in node.columns:
+                    if c not in out[node.table]:
+                        out[node.table].append(c)
+    return out
+
+
+class CompilingExecutor(JaxExecutor):
+    """JaxExecutor + whole-query compile cache keyed by SQL text.
+
+    First execution of a query discovers its size plan eagerly; later
+    executions run ONE jitted XLA program per query (the performance
+    contract on real TPUs).  Guard failure (size-class overflow after
+    data changes) or catalog-version changes trigger rediscovery.
+    """
+
+    def execute_cached(self, p: lp.Plan, key: str) -> Table:
+        versions = tuple(sorted(
+            getattr(self.catalog, "versions", {}).items()))
+        cp = self._compiled.get(key)
+        if cp is not None and cp.versions != versions:
+            cp = None
+        if cp is None:
+            return self._discover(p, key, versions)
+        if not cp.compilable or cp.fn is None:
+            return self.execute_to_host(cp.plan)
+        args = {t: self._accel_args(t, cols)
+                for t, cols in cp.table_cols.items()}
+        (out, alive), ok = cp.fn(args)
+        if not bool(ok):
+            self._compiled.pop(key, None)
+            return self._discover(p, key, versions)
+        alive_np = np.asarray(alive)
+        cols = {}
+        for name, ctype, dictionary in cp.out_meta:
+            data, valid = out[name]
+            data = np.asarray(data)[alive_np]
+            valid = np.asarray(valid)[alive_np]
+            cols[name] = Column(data, ctype,
+                                None if valid.all() else valid, dictionary)
+        return Table(cols)
+
+    def _discover(self, p: lp.Plan, key: str, versions) -> Table:
+        self._subq_cache = {}
+        self.np_exec = physical.Executor(self.catalog)
+        self.mode = "discover"
+        self._rec = []
+        self._used_fallback = False
+        try:
+            with host_compute():
+                dt = self.execute(p)
+                host = to_host(dt)
+        finally:
+            self.mode = "eager"
+        cp = _CompiledPlan(p, not self._used_fallback, self._rec, versions)
+        if cp.compilable:
+            cp.table_cols = _scan_columns(p)
+            cp.out_meta = [(name, c.ctype, c.dictionary)
+                           for name, c in dt.columns.items()]
+            try:
+                cp.fn = self._build_jit(cp)
+            except Exception:
+                cp.compilable = False
+        self._compiled[key] = cp
+        return host
+
+    def _table_args(self, name: str, cols: Optional[List[str]] = None):
+        dt = self._table_device(name)
+        names = dt.column_names if cols is None else cols
+        return ({n: (dt.columns[n].data, dt.columns[n].valid)
+                 for n in names}, dt.alive)
+
+    def _accel_args(self, name: str, cols: Optional[List[str]] = None):
+        """Replay inputs, resident on the accelerator (uploaded once per
+        (table version, column subset); the host copy feeds
+        eager/discovery)."""
+        version = getattr(self.catalog, "versions", {}).get(name)
+        ckey = (name, None if cols is None else tuple(sorted(cols)))
+        cached = self._accel_cache.get(ckey)
+        if cached is not None and cached[0] == version and \
+                version is not None:
+            return cached[1]
+        args = self._table_args(name, cols)
+        dev = jax.devices()[0]
+        if dev.platform != "cpu":
+            args = jax.device_put(args, dev)
+        self._accel_cache[ckey] = (version, args)
+        return args
+
+    def _build_jit(self, cp: _CompiledPlan):
+        metas = {}
+        for name in cp.table_cols:
+            dt = self._table_device(name)
+            metas[name] = {n: (c.ctype, c.dictionary)
+                           for n, c in dt.columns.items()}
+
+        def replay(tables):
+            self._subq_cache = {}
+            self.mode = "replay"
+            self._pos = 0
+            self._oks = []
+            self._rec = cp.record
+            self._trace_tables = {}
+            for name, (cols, alive) in tables.items():
+                dcols = {n: DCol(d, v, metas[name][n][0], metas[name][n][1])
+                         for n, (d, v) in cols.items()}
+                self._trace_tables[name] = DTable(dcols, alive)
+            try:
+                dt = self.execute(cp.plan)
+                ok = jnp.asarray(True)
+                for o in self._oks:
+                    ok = ok & o
+            finally:
+                self.mode = "eager"
+                self._trace_tables = None
+            out = {name: (c.data, c.valid) for name, c in dt.columns.items()}
+            return (out, dt.alive), ok
+
+        return jax.jit(replay)
 
 
 def execute(plan: lp.Plan, catalog) -> Table:

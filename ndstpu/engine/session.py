@@ -23,11 +23,14 @@ class Session:
     # ndslake warehouse root for ACID INSERT/DELETE passthrough (maintenance)
     warehouse: Optional[str] = None
     backend: str = "cpu"  # cpu | tpu (tpu falls back per-plan when needed)
+    # bumped on view create/drop — part of the compiled-query cache key
+    # (same SQL text over a redefined view must not reuse a stale plan)
+    _views_epoch: int = 0
 
     def sql(self, text: str) -> Optional[columnar.Table]:
         """Execute one statement; returns a Table for queries, None for DDL."""
         stmt = parse_statement(text)
-        return self._run(stmt)
+        return self._run(stmt, key=text)
 
     def sql_script(self, text: str) -> List[Optional[columnar.Table]]:
         return [self._run(s) for s in parse_statements(text)]
@@ -41,13 +44,14 @@ class Session:
         from ndstpu.engine.optimizer import optimize
         return optimize(plan, self.catalog), cols
 
-    def _run(self, stmt: ast.Node) -> Optional[columnar.Table]:
+    def _run(self, stmt: ast.Node,
+             key: Optional[str] = None) -> Optional[columnar.Table]:
         if isinstance(stmt, ast.Query):
             planner = pl.Planner(self.catalog, dict(self.views))
             plan, cols = planner.plan_query(stmt)
             from ndstpu.engine.optimizer import optimize
             plan = optimize(plan, self.catalog)
-            out = self._execute(plan)
+            out = self._execute(plan, key=key)
             # display names: strip alias qualifiers
             disp = planner._display_names(cols)
             return columnar.Table(dict(zip(self._dedupe(disp),
@@ -60,6 +64,7 @@ class Session:
             self.views[stmt.name] = lp.Project(
                 plan, [(d, ex.ColumnRef(c)) for d, c in zip(
                     self._dedupe(disp), cols)])
+            self._views_epoch += 1
             return None
         if isinstance(stmt, ast.CreateTableAs):
             t = self._run(stmt.query)
@@ -71,6 +76,7 @@ class Session:
             return self._delete(stmt)
         if isinstance(stmt, ast.DropRel):
             self.views.pop(stmt.name, None)
+            self._views_epoch += 1
             if stmt.kind == "table":
                 self.catalog.unregister(stmt.name)
             return None
@@ -89,19 +95,25 @@ class Session:
                 out.append(n)
         return out
 
-    def _execute(self, plan: lp.Plan) -> columnar.Table:
+    def _execute(self, plan: lp.Plan,
+                 key: Optional[str] = None) -> columnar.Table:
         if self.backend == "tpu":
-            return self._jax_executor().execute_to_host(plan)
+            exe = self._jax_executor()
+            if key is not None:
+                return exe.execute_cached(
+                    plan, f"{self._views_epoch}|{key}")
+            return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
 
     def _jax_executor(self):
-        """One JaxExecutor per session: keeps uploaded tables cached in HBM
-        across queries (analog of Spark's cached TempViews).  Per-table
-        invalidation happens inside the executor via catalog versions."""
+        """One executor per session: keeps uploaded tables cached in HBM
+        and whole-query compiled programs cached by SQL text (analog of
+        Spark's cached TempViews + codegen cache).  Per-table invalidation
+        happens inside the executor via catalog versions."""
         from ndstpu.engine import jaxexec
         exe = getattr(self, "_jax_exec_cache", None)
         if exe is None or exe.catalog is not self.catalog:
-            exe = jaxexec.JaxExecutor(self.catalog)
+            exe = jaxexec.CompilingExecutor(self.catalog)
             self._jax_exec_cache = exe
         return exe
 

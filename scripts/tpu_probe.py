@@ -1,0 +1,37 @@
+"""Quick real-TPU probe: time q3 on the compiled replay path.
+
+Run 1 = eager discovery on host CPU backend + jit compile for TPU.
+Run 2+ = one XLA program on the TPU per execution.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax  # noqa: E402
+
+from ndstpu.engine.session import Session  # noqa: E402
+from ndstpu.io import loader  # noqa: E402
+from ndstpu.queries import streamgen  # noqa: E402
+
+wh = sys.argv[1] if len(sys.argv) > 1 else "/tmp/vfy/pq"
+print("default device:", jax.devices()[0])
+
+t0 = time.time()
+catalog = loader.load_catalog(wh)
+print(f"load_catalog: {time.time() - t0:.2f}s")
+
+sess = Session(catalog, backend="tpu")
+sql = streamgen.render_template(
+    str(streamgen.TEMPLATE_DIR / "query3.tpl"), "07291122510", 0)
+
+for i in range(4):
+    t0 = time.time()
+    out = sess.sql(sql)
+    rows = out.to_rows()
+    print(f"run {i}: {time.time() - t0:.3f}s  rows={len(rows)}")
+
+exe = sess._jax_executor()
+cp = exe._compiled[sql]
+print("compilable:", cp.compilable)

@@ -188,3 +188,102 @@ def test_empty_result(cpu_sess, tpu_sess):
     _both(cpu_sess, tpu_sess,
           "select ss_item_sk, ss_quantity from store_sales "
           "where ss_quantity > 1000000")
+
+
+def test_window_functions(cpu_sess, tpu_sess):
+    _both(cpu_sess, tpu_sess,
+          "select * from (select i_category, i_item_id, "
+          "rank() over (partition by i_category "
+          "order by i_current_price desc) as r from item) t where r <= 3")
+    _both(cpu_sess, tpu_sess,
+          "select ss_store_sk, ss_item_sk, "
+          "sum(ss_net_paid) over (partition by ss_store_sk) as tot, "
+          "row_number() over (partition by ss_store_sk "
+          "order by ss_item_sk, ss_ticket_number) as rn "
+          "from store_sales where ss_quantity > 40")
+
+
+def test_rollup_on_device(cpu_sess, tpu_sess):
+    _both(cpu_sess, tpu_sess,
+          "select i_category, i_class, sum(ss_ext_sales_price) as s "
+          "from store_sales, item where ss_item_sk = i_item_sk "
+          "group by rollup(i_category, i_class) "
+          "order by i_category, i_class", ordered=False)
+
+
+def test_setops_on_device(cpu_sess, tpu_sess):
+    _both(cpu_sess, tpu_sess,
+          "select d_year from date_dim where d_moy = 11 intersect "
+          "select d_year from date_dim where d_moy = 12")
+    _both(cpu_sess, tpu_sess,
+          "select i_category from item except "
+          "select i_category from item where i_current_price > 50")
+    _both(cpu_sess, tpu_sess,
+          "select d_year from date_dim where d_year > 2000 union "
+          "select d_year from date_dim where d_year < 1995")
+
+
+def test_full_and_right_joins(cpu_sess, tpu_sess):
+    _both(cpu_sess, tpu_sess,
+          "select sr_item_sk, sr_ticket_number, ss_quantity from "
+          "store_returns right join store_sales on "
+          "sr_item_sk = ss_item_sk and sr_ticket_number = "
+          "ss_ticket_number where ss_quantity > 45")
+
+
+def test_corpus_compile_coverage(catalog):
+    """Most corpus templates must compile to single XLA programs (no
+    numpy fallback) — fallbacks are allowed but should be the minority."""
+    from ndstpu.engine.session import Session
+    sess = Session(catalog, backend="tpu")
+    compiled, fallback = [], []
+    for tpl in streamgen.list_templates():
+        sql = streamgen.render_template(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
+        sess.sql(sql)
+        exe = sess._jax_executor()
+        cp = exe._compiled.get(sql)
+        (compiled if cp is not None and cp.compilable
+         else fallback).append(tpl)
+    assert len(compiled) >= 0.8 * (len(compiled) + len(fallback)), \
+        f"too many fallbacks: {fallback}"
+
+
+def test_compiled_replay_path(catalog, cpu_sess):
+    """Second execution of a query must run the jitted whole-query
+    program (replay) and agree with both the first run and the CPU
+    interpreter."""
+    from ndstpu.engine.session import Session
+    sess = Session(catalog, backend="tpu")
+    sql = ("select i_category, count(*) as cnt, "
+           "sum(ss_ext_sales_price) as s "
+           "from store_sales join item on ss_item_sk = i_item_sk "
+           "where ss_quantity > 5 "
+           "group by i_category order by i_category")
+    first = sess.sql(sql)
+    exe = sess._jax_executor()
+    assert sql in exe._compiled
+    cp = exe._compiled[sql]
+    assert cp.compilable and cp.fn is not None
+    second = sess.sql(sql)   # replay path
+    assert_tables_match(first, second, ordered=True)
+    assert_tables_match(cpu_sess.sql(sql), second, ordered=True)
+
+
+def test_compiled_invalidation_on_dml(catalog):
+    """Catalog version changes must invalidate compiled plans (stale
+    baked subquery literals / table uploads)."""
+    from ndstpu.engine.session import Session
+    sess = Session(catalog, backend="tpu")
+    sql = "select count(*) as n from item"
+    before = sess.sql(sql).to_rows()[0][0]
+    item = catalog.get("item")
+    import numpy as np
+    keep = np.ones(item.num_rows, dtype=bool)
+    if item.num_rows:
+        keep[0] = False
+    catalog.register("item", item.filter(keep))
+    after = sess.sql(sql).to_rows()[0][0]
+    assert after == before - (1 if before else 0)
+    # restore for other tests
+    catalog.register("item", item)
