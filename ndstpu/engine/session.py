@@ -105,6 +105,14 @@ class Session:
             return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
 
+    def compiled_plan(self, text: str):
+        """The cached whole-query compile record for a SQL text (or None).
+        Test/introspection hook — mirrors the key used by `_execute`."""
+        exe = getattr(self, "_jax_exec_cache", None)
+        if exe is None:
+            return None
+        return exe._compiled.get(f"{self._views_epoch}|{text}")
+
     def _jax_executor(self):
         """One executor per session: keeps uploaded tables cached in HBM
         and whole-query compiled programs cached by SQL text (analog of

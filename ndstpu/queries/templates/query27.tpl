@@ -1,0 +1,22 @@
+--@ define GEN = choice('M', 'F')
+--@ define MS = choice('M', 'S', 'D', 'W', 'U')
+--@ define ES = choice('Primary', 'Secondary', 'College', '2 yr Degree', '4 yr Degree', 'Advanced Degree', 'Unknown')
+--@ define YEAR = uniform(1998, 2002)
+select i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1,
+       avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3,
+       avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = '[GEN]'
+  and cd_marital_status = '[MS]'
+  and cd_education_status = '[ES]'
+  and d_year = [YEAR]
+  and s_state in ('TN', 'AL', 'GA', 'SC', 'NC', 'KY')
+group by rollup(i_item_id, s_state)
+order by i_item_id, s_state
+limit 100

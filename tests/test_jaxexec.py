@@ -241,8 +241,7 @@ def test_corpus_compile_coverage(catalog):
         sql = streamgen.render_template(
             str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
         sess.sql(sql)
-        exe = sess._jax_executor()
-        cp = exe._compiled.get(sql)
+        cp = sess.compiled_plan(sql)
         (compiled if cp is not None and cp.compilable
          else fallback).append(tpl)
     assert len(compiled) >= 0.8 * (len(compiled) + len(fallback)), \
