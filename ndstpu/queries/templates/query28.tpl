@@ -1,0 +1,47 @@
+--@ define LP = uniform(0, 190)
+--@ define CA = uniform(0, 18000)
+--@ define WC = uniform(0, 80)
+select *
+from (select avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+             count(distinct ss_list_price) b1_cntd
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b1,
+     (select avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+             count(distinct ss_list_price) b2_cntd
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b2,
+     (select avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+             count(distinct ss_list_price) b3_cntd
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b3,
+     (select avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+             count(distinct ss_list_price) b4_cntd
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b4,
+     (select avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+             count(distinct ss_list_price) b5_cntd
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b5,
+     (select avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+             count(distinct ss_list_price) b6_cntd
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between [LP] and [LP] + 10
+             or ss_coupon_amt between [CA] and [CA] + 1000
+             or ss_wholesale_cost between [WC] and [WC] + 20)) b6
+limit 100

@@ -260,9 +260,8 @@ def test_compiled_replay_path(catalog, cpu_sess):
            "where ss_quantity > 5 "
            "group by i_category order by i_category")
     first = sess.sql(sql)
-    exe = sess._jax_executor()
-    assert sql in exe._compiled
-    cp = exe._compiled[sql]
+    cp = sess.compiled_plan(sql)
+    assert cp is not None
     assert cp.compilable and cp.fn is not None
     second = sess.sql(sql)   # replay path
     assert_tables_match(first, second, ordered=True)
