@@ -503,13 +503,17 @@ class Planner:
                     if isinstance(conj, ex.BinOp) and conj.op == "=" and \
                             isinstance(conj.left, ex.ColumnRef) and \
                             isinstance(conj.right, ex.ColumnRef):
+                        # the outer side must NOT be producible by the
+                        # subplan itself (membership in outer_cols alone is
+                        # ambiguous when inner and outer scan the same
+                        # unaliased table, e.g. q32/q92 catalog_sales)
                         l, r = conj.left.name, conj.right.name
-                        if l in outer_cols and r in child_cols and \
-                                r not in outer_cols:
+                        if l in outer_cols and l not in child_cols and \
+                                r in child_cols:
                             corr.append((l, r))
                             continue
-                        if r in outer_cols and l in child_cols and \
-                                l not in outer_cols:
+                        if r in outer_cols and r not in child_cols and \
+                                l in child_cols:
                             corr.append((r, l))
                             continue
                     keep.append(conj)
@@ -663,6 +667,10 @@ class Planner:
                                to_agg_output(be.default)
                                if be.default is not None else None)
             if isinstance(be, (ex.Literal,)):
+                return be
+            if isinstance(be, ex.SubqueryExpr) and not be.correlated_predicates:
+                # uncorrelated scalar subquery (e.g. q44's HAVING
+                # `avg(x) > 0.9 * (select ...)`) — a constant at exec time
                 return be
             if isinstance(be, ex.UnaryOp):
                 return ex.UnaryOp(be.op, to_agg_output(be.operand))
