@@ -56,6 +56,25 @@ _NUMPY_DTYPES = {
 }
 
 
+_DATE_RE = None
+
+
+def parse_date_days(s: str) -> int:
+    """Days since 1970-01-01 for a date string; tolerates non-padded
+    month/day ('2002-4-01', Spark-compatible) unlike raw np.datetime64."""
+    global _DATE_RE
+    if _DATE_RE is None:
+        import re
+        _DATE_RE = re.compile(r"^(\d{4})-(\d{1,2})-(\d{1,2})$")
+    s = s.strip()
+    m = _DATE_RE.match(s)
+    if m:
+        y, mo, d = m.groups()
+        s = f"{y}-{int(mo):02d}-{int(d):02d}"
+    return int((np.datetime64(s, "D") -
+                np.datetime64("1970-01-01")).astype(int))
+
+
 def numpy_dtype(ctype: DType):
     return _NUMPY_DTYPES[ctype.kind]
 

@@ -287,14 +287,13 @@ def cast_column(c: Column, target: DType) -> Column:
         return Column(data, target, v)
     if tk == "date":
         if k == "string":
-            base = np.datetime64("1970-01-01")
             out = np.zeros(len(c.data), dtype=np.int32)
             valid = c.validity().copy()
             for i, x in enumerate(c.to_pylist()):
                 if x is None:
                     valid[i] = False
                     continue
-                out[i] = int((np.datetime64(x, "D") - base).astype(int))
+                out[i] = columnar.parse_date_days(x)
             return Column(out, DATE, valid)
         return Column(c.data.astype(np.int32), DATE, v)
     if tk == "string":

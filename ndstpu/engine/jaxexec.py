@@ -390,8 +390,7 @@ class JEval:
         ok = np.zeros(len(c.dictionary) + 1, dtype=bool)
         for i, s in enumerate(c.dictionary):
             try:
-                vals[i] = int((np.datetime64(str(s), "D") - base)
-                              .astype(int))
+                vals[i] = columnar.parse_date_days(str(s))
                 ok[i] = True
             except ValueError:
                 pass
@@ -1496,6 +1495,8 @@ class JaxExecutor:
 
     def _exec_join(self, p: lp.Join) -> DTable:
         kind = p.kind
+        if kind == "mark":
+            raise Unsupported("mark join")
         lt = self.execute(p.left)
         rt = self.execute(p.right)
         extra = self._resolve_subqueries(p.extra) \

@@ -89,6 +89,10 @@ def _output_names(p: lp.Plan) -> List[str]:
     if isinstance(p, lp.Window):
         return _output_names(p.child) + [n for n, _ in p.exprs]
     if isinstance(p, lp.Join):
+        if p.kind == "mark":
+            return _output_names(p.left) + [p.mark]
+        if p.kind in ("semi", "anti", "nullaware_anti"):
+            return _output_names(p.left)
         return _output_names(p.left) + _output_names(p.right)
     if isinstance(p, lp.Scan):
         raise RuntimeError("bare Scan in optimizer (planner wraps in Project)")
@@ -191,7 +195,8 @@ def _push_conjuncts(p: lp.Plan, conjs: List[ex.Expr]) -> lp.Plan:
                         p.kind = "inner"
                         continue
             if refs <= lcols and p.kind in ("inner", "left", "semi", "anti",
-                                            "nullaware_anti", "cross"):
+                                            "nullaware_anti", "cross",
+                                            "mark"):
                 lpush.append(c)
             elif refs <= rcols and p.kind in ("inner", "cross"):
                 rpush.append(c)
@@ -330,7 +335,7 @@ def _estimate_rows(p: lp.Plan, catalog) -> float:
     if isinstance(p, lp.Join):
         l = _estimate_rows(p.left, catalog)
         r = _estimate_rows(p.right, catalog)
-        if p.kind in ("semi", "anti", "nullaware_anti"):
+        if p.kind in ("semi", "anti", "nullaware_anti", "mark"):
             return l
         return max(l, r)
     if isinstance(p, lp.InlineTable):

@@ -217,6 +217,17 @@ class Executor:
         counts = (hi - lo)
         matched = counts > 0
 
+        if kind == "mark":
+            mask = matched
+            if p.extra is not None:
+                inner = self._expand_join(lt, rt, order, lo, hi, counts)
+                keep = ex.eval_predicate(inner, p.extra)
+                li = self._expand_left_indices(counts)[keep]
+                mask = np.zeros(lt.num_rows, dtype=bool)
+                mask[li] = True
+            return Table({**lt.columns,
+                          p.mark: Column(mask, BOOL)})
+
         if kind in ("semi", "anti"):
             mask = matched if kind == "semi" else ~matched
             if p.extra is not None and kind == "semi":

@@ -65,10 +65,13 @@ class Project(Plan):
 class Join(Plan):
     left: Plan
     right: Plan
-    kind: str  # inner, left, right, full, semi, anti, cross
+    kind: str  # inner, left, right, full, semi, anti, cross, mark
     # equi-join key pairs (left expr, right expr); non-equi residual in extra
     keys: List[Tuple[Expr, Expr]]
     extra: Optional[Expr] = None
+    # "mark" joins: output = left columns + a boolean column named `mark`
+    # that is True where the row has a match (EXISTS under OR/CASE)
+    mark: Optional[str] = None
 
     def children(self):
         return (self.left, self.right)
@@ -163,7 +166,7 @@ def copy_plan(p: Plan) -> Plan:
         return Project(copy_plan(p.child), list(p.exprs))
     if isinstance(p, Join):
         return Join(copy_plan(p.left), copy_plan(p.right), p.kind,
-                    list(p.keys), p.extra)
+                    list(p.keys), p.extra, p.mark)
     if isinstance(p, Aggregate):
         return Aggregate(copy_plan(p.child), list(p.group_by), list(p.aggs),
                          None if p.grouping_sets is None

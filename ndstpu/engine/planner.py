@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ndstpu.engine import expr as ex, plan as lp
+from ndstpu.engine import columnar, expr as ex, plan as lp
 from ndstpu.engine.columnar import DATE, DType, FLOAT64, INT32, INT64, STRING
 from ndstpu.engine.sql import ast
 from ndstpu.schema import decimal as decimal_t
@@ -55,8 +55,7 @@ def _parse_type(name: str) -> DType:
 
 
 def _date_to_days(s: str) -> int:
-    return int((np.datetime64(s, "D") -
-                np.datetime64("1970-01-01", "D")).astype(int))
+    return columnar.parse_date_days(s)
 
 
 @dataclasses.dataclass
@@ -237,6 +236,10 @@ class Planner:
         if isinstance(p, lp.Window):
             return self._plan_output_names(p.child) + [n for n, _ in p.exprs]
         if isinstance(p, lp.Join):
+            if p.kind == "mark":
+                return self._plan_output_names(p.left) + [p.mark]
+            if p.kind in ("semi", "anti", "nullaware_anti"):
+                return self._plan_output_names(p.left)
             return (self._plan_output_names(p.left) +
                     self._plan_output_names(p.right))
         if isinstance(p, lp.SubqueryAlias):
@@ -397,11 +400,62 @@ class Planner:
             handled, plan = self._try_subquery_conjunct(plan, conj, scope)
             if handled:
                 continue
+            if _ast_contains_exists(conj):
+                # EXISTS under OR (q10/q35 shape): plan each EXISTS as a
+                # mark join producing a boolean column, then filter on the
+                # rewritten predicate referencing the marks
+                plan, conj = self._rewrite_exists_marks(plan, conj, scope)
             plain.append(self._bind(conj, scope))
         cond = _conjoin(plain)
         if cond is not None:
             plan = lp.Filter(plan, cond)
         return plan
+
+    def _rewrite_exists_marks(self, plan: lp.Plan, node: ast.Node,
+                              scope: Scope) -> Tuple[lp.Plan, ast.Node]:
+        """Replace every EXISTS inside an arbitrary boolean expression with
+        a MarkRef to a mark-join column appended to `plan`."""
+        import dataclasses as _dc
+
+        def walk(n):
+            nonlocal plan
+            if isinstance(n, ast.Exists):
+                name = self.fresh("mark")
+                plan = self._plan_exists_mark(plan, n.query, scope, name)
+                ref: ast.Node = ast.MarkRef(name)
+                return ast.Un("not", ref) if n.negated else ref
+            if isinstance(n, (ast.ScalarQuery, ast.InQuery, ast.Query)):
+                return n
+            if isinstance(n, ast.Node):
+                kw = {f.name: walk_val(getattr(n, f.name))
+                      for f in _dc.fields(n)}
+                return type(n)(**kw)
+            return n
+
+        def walk_val(v):
+            if isinstance(v, ast.Node):
+                return walk(v)
+            if isinstance(v, list):
+                return [walk_val(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(walk_val(x) for x in v)
+            return v
+
+        rewritten = walk(node)  # mutates `plan` via nonlocal
+        return plan, rewritten
+
+    def _plan_exists_mark(self, plan: lp.Plan, q: ast.Query, scope: Scope,
+                          name: str) -> lp.Plan:
+        sub_scope = Scope(scope)
+        sub_plan, _cols = self.plan_query(q, sub_scope)
+        if not sub_scope.outer_refs:
+            raise PlanError("uncorrelated EXISTS unsupported")
+        sub_plan, corr, residual = self._extract_correlation(
+            sub_plan, scope, collect_residual=True)
+        if not corr:
+            raise PlanError("EXISTS without equality correlation unsupported")
+        keys = [(ex.ColumnRef(o), ex.ColumnRef(i)) for o, i in corr]
+        return lp.Join(plan, sub_plan, "mark", keys, _conjoin(residual), name)
 
     def _try_subquery_conjunct(self, plan: lp.Plan, conj: ast.Node,
                                scope: Scope) -> Tuple[bool, lp.Plan]:
@@ -474,16 +528,22 @@ class Planner:
         sub_plan, _cols = self.plan_query(q, sub_scope)
         if not sub_scope.outer_refs:
             raise PlanError("uncorrelated EXISTS unsupported")
-        sub_plan, corr = self._extract_correlation(sub_plan, scope)
+        sub_plan, corr, residual = self._extract_correlation(
+            sub_plan, scope, collect_residual=True)
         if not corr:
             raise PlanError("EXISTS without equality correlation unsupported")
         keys = [(ex.ColumnRef(o), ex.ColumnRef(i)) for o, i in corr]
-        return lp.Join(plan, sub_plan, "anti" if negated else "semi", keys)
+        return lp.Join(plan, sub_plan, "anti" if negated else "semi", keys,
+                       _conjoin(residual))
 
-    def _extract_correlation(self, sub_plan: lp.Plan, outer_scope: Scope):
+    def _extract_correlation(self, sub_plan: lp.Plan, outer_scope: Scope,
+                             collect_residual: bool = False):
         """Pull `outer_col = inner_col` predicates out of the subplan's
         filters.  Returns (rewritten subplan, [(outer_internal,
-        inner_internal)])."""
+        inner_internal)]) — plus a residual predicate list when
+        `collect_residual` (non-equi correlated conjuncts like
+        ``cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk`` in q16/q94, which
+        become the semi/anti join's `extra`)."""
         outer_cols = set()
         sc = outer_scope
         while sc is not None:
@@ -493,13 +553,15 @@ class Planner:
             sc = sc.parent
 
         corr: List[Tuple[str, str]] = []
+        residual: List[ex.Expr] = []
+        residual_inner: List[str] = []  # inner cols the residual needs
 
         def rewrite(p: lp.Plan) -> lp.Plan:
             if isinstance(p, lp.Filter):
                 child = rewrite(p.child)
                 child_cols = self._plan_columns(child)
                 keep: List[ex.Expr] = []
-                for conj in _conjuncts(p.condition):
+                for conj in _conjuncts(_factor_or_common(p.condition)):
                     if isinstance(conj, ex.BinOp) and conj.op == "=" and \
                             isinstance(conj.left, ex.ColumnRef) and \
                             isinstance(conj.right, ex.ColumnRef):
@@ -515,6 +577,14 @@ class Planner:
                         if r in outer_cols and r not in child_cols and \
                                 l in child_cols:
                             corr.append((r, l))
+                            continue
+                    if collect_residual:
+                        refs = {n.name for n in conj.walk()
+                                if isinstance(n, ex.ColumnRef)}
+                        out_refs = refs & (outer_cols - child_cols)
+                        if out_refs and (refs - out_refs) <= child_cols:
+                            residual.append(conj)
+                            residual_inner.extend(refs & child_cols)
                             continue
                     keep.append(conj)
                 cond = _conjoin(keep)
@@ -532,9 +602,13 @@ class Planner:
         # correlation columns must be visible in subplan output for the join:
         # wrap subplan in a project exposing them
         sub_cols = self._plan_output_names(sub_plan)
-        missing = [i for _o, i in corr if i not in sub_cols]
+        missing = [i for i in
+                   dict.fromkeys([i for _o, i in corr] + residual_inner)
+                   if i not in sub_cols]
         if missing:
             sub_plan = _expose_columns(sub_plan, missing)
+        if collect_residual:
+            return sub_plan, corr, residual
         return sub_plan, corr
 
     def _plan_corr_scalar_cmp(self, plan: lp.Plan, other_ast: ast.Node,
@@ -827,6 +901,8 @@ class Planner:
                 return alias_map[e.name]
             name, _outer = scope.resolve(e.table, e.name)
             return ex.ColumnRef(name)
+        if isinstance(e, ast.MarkRef):
+            return ex.ColumnRef(e.name)
         if isinstance(e, ast.Lit):
             return ex.Literal(e.value)
         if isinstance(e, ast.DateLit):
@@ -964,6 +1040,47 @@ def _ast_conjuncts(e: ast.Node) -> List[ast.Node]:
     if isinstance(e, ast.Bin) and e.op == "and":
         return _ast_conjuncts(e.left) + _ast_conjuncts(e.right)
     return [e]
+
+
+def _ast_contains_exists(e) -> bool:
+    """True if an EXISTS occurs anywhere inside the expression (without
+    descending into nested sub-queries, whose own planning handles them)."""
+    import dataclasses as _dc
+    if isinstance(e, ast.Exists):
+        return True
+    if isinstance(e, (ast.ScalarQuery, ast.InQuery, ast.Query)):
+        return False
+    if isinstance(e, ast.Node):
+        return any(_ast_contains_exists(getattr(e, f.name))
+                   for f in _dc.fields(e))
+    if isinstance(e, (list, tuple)):
+        return any(_ast_contains_exists(x) for x in e)
+    return False
+
+
+def _factor_or_common(e: ex.Expr) -> ex.Expr:
+    """Factor conjuncts common to both branches of an OR:
+    ``(A and X) or (A and Y)`` -> ``A and (X or Y)`` (recursively).  Makes
+    equality correlations inside disjunctions visible to the decorrelator
+    (q41 shape)."""
+    if isinstance(e, ex.BinOp) and e.op == "and":
+        return ex.BinOp("and", _factor_or_common(e.left),
+                        _factor_or_common(e.right))
+    if isinstance(e, ex.BinOp) and e.op == "or":
+        l = _factor_or_common(e.left)
+        r = _factor_or_common(e.right)
+        lc, rc = _conjuncts(l), _conjuncts(r)
+        common = [c for c in lc if c in rc]
+        if not common:
+            return ex.BinOp("or", l, r)
+        lrest = [c for c in lc if c not in common]
+        rrest = [c for c in rc if c not in common]
+        if not lrest or not rrest:
+            # (A) or (A and X)  ->  A
+            return _conjoin(common)
+        return _conjoin(common + [ex.BinOp("or", _conjoin(lrest),
+                                           _conjoin(rrest))])
+    return e
 
 
 def _conjoin(parts: Sequence[ex.Expr]) -> Optional[ex.Expr]:

@@ -94,6 +94,14 @@ class ScalarQuery(Node):
 
 
 @dataclasses.dataclass
+class MarkRef(Node):
+    """Planner-internal: reference to a mark-join boolean column (the
+    residue of an EXISTS planned as a mark join).  Never produced by the
+    parser."""
+    name: str
+
+
+@dataclasses.dataclass
 class LikeOp(Node):
     operand: Node
     pattern: str

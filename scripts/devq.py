@@ -1,0 +1,60 @@
+"""Dev loop: render + execute one or more query templates against a
+pre-built warehouse (default /tmp/devwh/wh).  Usage:
+
+    python scripts/devq.py query2 query4 ...
+    python scripts/devq.py --all          # every template in the corpus
+"""
+import argparse
+import sys
+import time
+import traceback
+
+from ndstpu.engine.session import Session
+from ndstpu.io import loader
+from ndstpu.queries import streamgen
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("names", nargs="*")
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--wh", default="/tmp/devwh/wh")
+    ap.add_argument("--seed", default="07291122510")
+    ap.add_argument("--show", action="store_true",
+                    help="print first rows of each result")
+    args = ap.parse_args()
+    names = args.names
+    if args.all:
+        names = [t[:-4] for t in streamgen.list_templates()]
+    sess = Session(loader.load_catalog(args.wh))
+    failed = []
+    for name in names:
+        tpl = name if name.endswith(".tpl") else name + ".tpl"
+        try:
+            sql = streamgen.render_template(
+                str(streamgen.TEMPLATE_DIR / tpl), args.seed, 0)
+            t0 = time.time()
+            out = None
+            for stmt in [s for s in sql.split(";") if s.strip()]:
+                out = sess.sql(stmt)
+            dt = time.time() - t0
+            nrows = out.num_rows if out is not None else 0
+            print(f"OK   {name:10s} {nrows:6d} rows  {dt*1000:7.1f} ms")
+            if args.show and out is not None:
+                cols = out.column_names
+                print("     " + " | ".join(cols))
+                for i in range(min(5, out.num_rows)):
+                    print("     " + " | ".join(
+                        str(out.column(c).to_pylist()[i]) for c in cols))
+        except Exception as e:
+            failed.append(name)
+            print(f"FAIL {name:10s} {type(e).__name__}: {e}")
+            if len(names) == 1:
+                traceback.print_exc()
+    if failed:
+        print(f"\n{len(failed)} failed: {' '.join(failed)}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
