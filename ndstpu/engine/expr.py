@@ -158,6 +158,10 @@ class WindowExpr(Expr):
     arg: Optional[Expr]
     partition_by: Tuple[Expr, ...]
     order_by: Tuple[Tuple[Expr, bool], ...]  # (expr, ascending)
+    # "rows" | "range" running frame (UNBOUNDED PRECEDING..CURRENT ROW);
+    # None = no explicit frame (aggregates with order_by still run as a
+    # RANGE running frame, Spark's default)
+    frame: Optional[str] = None
 
     def children(self):
         out = list(self.partition_by) + [e for e, _ in self.order_by]

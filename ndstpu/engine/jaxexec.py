@@ -1322,7 +1322,10 @@ class JaxExecutor:
                 ranks = csum - base + 1
             return DCol(ranks[inv].astype(jnp.int64),
                         jnp.ones(cap, bool), INT64)
-        # aggregate window over the whole partition (no frames)
+        # aggregate window over the whole partition; running frames
+        # (ORDER BY present) execute on the exact numpy path for now
+        if w.order_by:
+            raise Unsupported("running-frame aggregate window")
         gid = pid
         if w.func == "count" and (w.arg is None or
                                   isinstance(w.arg, ex.Star)):

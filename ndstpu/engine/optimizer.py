@@ -71,7 +71,8 @@ def _substitute(e: ex.Expr, mapping: Dict[str, ex.Expr]) -> ex.Expr:
             None if e.arg is None or isinstance(e.arg, ex.Star)
             else _substitute(e.arg, mapping),
             tuple(_substitute(p, mapping) for p in e.partition_by),
-            tuple((_substitute(o, mapping), a) for o, a in e.order_by))
+            tuple((_substitute(o, mapping), a) for o, a in e.order_by),
+            e.frame)
     return e
 
 
@@ -434,8 +435,42 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
     return lp.Filter(current, cond) if cond is not None else current
 
 
+def _plan_exprs(p: lp.Plan) -> List[ex.Expr]:
+    if isinstance(p, lp.Scan):
+        return [p.predicate] if p.predicate is not None else []
+    if isinstance(p, lp.Filter):
+        return [p.condition]
+    if isinstance(p, lp.Project):
+        return [e for _n, e in p.exprs]
+    if isinstance(p, lp.Join):
+        out = [e for pair in p.keys for e in pair]
+        if p.extra is not None:
+            out.append(p.extra)
+        return out
+    if isinstance(p, lp.Aggregate):
+        return [e for _n, e in p.group_by] + [e for _n, e in p.aggs]
+    if isinstance(p, lp.Window):
+        return [e for _n, e in p.exprs]
+    if isinstance(p, lp.Sort):
+        return [entry[0] for entry in p.keys]
+    return []
+
+
+def _optimize_embedded(p: lp.Plan, catalog) -> None:
+    """Optimize plans embedded in SubqueryExpr leaves (uncorrelated scalar /
+    IN subqueries survive planning as expressions — without this their join
+    trees stay cross joins, q24's HAVING subquery)."""
+    for e in _plan_exprs(p):
+        for x in e.walk():
+            if isinstance(x, ex.SubqueryExpr) and x.plan is not None:
+                object.__setattr__(x, "plan", optimize(x.plan, catalog))
+    for c in p.children():
+        _optimize_embedded(c, catalog)
+
+
 def optimize(p: lp.Plan, catalog=None) -> lp.Plan:
     p = push_filters(p)
     p = reorder_joins(p, catalog)
     p = prune(p, None)
+    _optimize_embedded(p, catalog)
     return p

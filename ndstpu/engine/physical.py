@@ -673,9 +673,14 @@ class Executor:
                 base = np.maximum.accumulate(np.where(newpart, c, 0))
                 ranks = c - base + 1
             return Column(ranks[inv].astype(np.int64), INT64)
-        # aggregate window over whole partition (no frame support yet)
+        # aggregate window: whole partition without ORDER BY; with ORDER BY
+        # a running UNBOUNDED PRECEDING..CURRENT ROW frame (Spark default
+        # RANGE — peers share the run value; explicit ROWS = per-row)
         arg = ev.eval(w.arg) if w.arg is not None and \
             not isinstance(w.arg, ex.Star) else None
+        if w.order_by:
+            return self._running_window(w, arg, pid, order, inv, newpart,
+                                        okeys)
         if w.func == "count" and arg is None:
             cnt = np.bincount(pid, minlength=int(pid.max()) + 1 if n else 0)
             return Column(cnt[pid].astype(np.int64), INT64)
@@ -710,6 +715,84 @@ class Executor:
         if w.func == "count":
             return Column(cnts[pid].astype(np.int64), INT64)
         raise NotImplementedError(f"window {w.func}")
+
+    def _running_window(self, w: ex.WindowExpr, arg: Optional[Column],
+                        pid: np.ndarray, order: np.ndarray,
+                        inv: np.ndarray, newpart: np.ndarray,
+                        okeys: List[np.ndarray]) -> Column:
+        """UNBOUNDED PRECEDING..CURRENT ROW running aggregate (q51 shape).
+        RANGE (the default) lets peer rows share the value of the last row
+        of their tie-run; explicit ROWS is strictly per-row."""
+        n = len(pid)
+        idx = np.arange(n)
+        pstart = np.maximum.accumulate(np.where(newpart, idx, 0))
+        use_peers = w.frame != "rows"
+        if use_peers:
+            okeys_s = [a[order] for a in okeys]
+            tie = np.zeros(n, dtype=bool)
+            if n > 1:
+                t = np.ones(n - 1, dtype=bool)
+                for a in okeys_s:
+                    t &= a[1:] == a[:-1]
+                tie[1:] = t & ~newpart[1:]
+            end_marker = np.ones(n, dtype=bool)
+            if n > 1:
+                end_marker[:-1] = ~tie[1:]
+            run_end = np.minimum.accumulate(
+                np.where(end_marker, idx, n)[::-1])[::-1]
+        else:
+            run_end = idx
+
+        def seg_cumsum(x):
+            cs = np.cumsum(x)
+            base = np.where(pstart > 0, cs[np.maximum(pstart - 1, 0)], 0)
+            return cs - base
+
+        if arg is None:  # count(*)
+            run = seg_cumsum(np.ones(n, dtype=np.int64))[run_end]
+            return Column(run[inv].astype(np.int64), INT64)
+        valid_s = arg.validity()[order]
+        data_s = arg.data[order]
+        rcnt = seg_cumsum(valid_s.astype(np.int64))[run_end]
+        got = rcnt > 0
+        gv = None if got.all() else got[inv]
+        if w.func == "count":
+            return Column(rcnt[inv].astype(np.int64), INT64)
+        if w.func == "sum" and arg.ctype.kind == "decimal":
+            run = seg_cumsum(
+                np.where(valid_s, data_s.astype(np.int64), 0))[run_end]
+            return Column(run[inv], decimal(38, arg.ctype.scale), gv)
+        if w.func in ("sum", "avg"):
+            x = np.where(valid_s, data_s.astype(np.float64), 0.0)
+            if arg.ctype.kind == "decimal":
+                x = x / (10 ** arg.ctype.scale)
+            run = seg_cumsum(x)[run_end]
+            if w.func == "avg":
+                run = run / np.maximum(rcnt, 1)
+            return Column(run[inv], FLOAT64, gv)
+        if w.func in ("min", "max"):
+            is_min = w.func == "min"
+            opfn = np.minimum if is_min else np.maximum
+            if arg.ctype.kind == "float64":
+                sent = np.inf if is_min else -np.inf
+                x = np.where(valid_s, data_s.astype(np.float64), sent)
+            else:
+                sent = np.iinfo(np.int64).max if is_min \
+                    else np.iinfo(np.int64).min
+                x = np.where(valid_s, data_s.astype(np.int64), sent)
+            out = x.copy()
+            shift = 1
+            while shift < n:
+                cand = np.empty_like(out)
+                cand[shift:] = out[:-shift]
+                cand[:shift] = sent
+                take = (idx - shift) >= pstart
+                out = np.where(take, opfn(out, cand), out)
+                shift *= 2
+            out = out[run_end]
+            return Column(out[inv].astype(arg.data.dtype), arg.ctype, gv,
+                          arg.dictionary)
+        raise NotImplementedError(f"running window {w.func}")
 
     # -- sort ----------------------------------------------------------------
 

@@ -758,7 +758,8 @@ class Planner:
                     else to_agg_output(be.arg),
                     tuple(to_agg_output(x) for x in be.partition_by),
                     tuple((to_agg_output(o), asc)
-                          for o, asc in be.order_by))
+                          for o, asc in be.order_by),
+                    be.frame)
                 name = self.fresh("w")
                 wexprs.append((name, w2))
                 return ex.ColumnRef(name)
@@ -977,7 +978,8 @@ class Planner:
             return ex.WindowExpr(
                 fc.name, arg,
                 tuple(b(p) for p in e.partition_by),
-                tuple((b(o), asc) for o, asc in e.order_by))
+                tuple((b(o), asc) for o, asc in e.order_by),
+                e.frame)
         if isinstance(e, ast.ScalarQuery):
             sub_scope = Scope(scope)
             sub_plan, sub_cols = self.plan_query(e.query, sub_scope)

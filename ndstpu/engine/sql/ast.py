@@ -121,6 +121,9 @@ class WindowCall(Node):
     func: FuncCall
     partition_by: List[Node]
     order_by: List[Tuple[Node, bool]]  # (expr, asc)
+    # explicit frame: "rows" | "range" (UNBOUNDED PRECEDING..CURRENT ROW);
+    # None = default (running RANGE frame when order_by present, Spark)
+    frame: "str | None" = None
 
 
 @dataclasses.dataclass

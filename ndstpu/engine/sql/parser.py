@@ -601,13 +601,20 @@ class Parser:
                 self.expect_kw("by")
                 for e, asc, _nf in self._order_list():
                     order_by.append((e, asc))
-            if self.at_kw("rows"):
-                # frame clauses: whole-partition frames only; consume tokens
+            frame = None
+            if self.at_kw("rows") or self.at_kw("range"):
+                frame = self.peek().value.lower()
                 self.next()
-                while not self.at_op(")"):
-                    self.next()
+                # only the running frame the TPC-DS corpus uses (q51):
+                #   BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+                self.expect_kw("between")
+                self.expect_kw("unbounded")
+                self.expect_kw("preceding")
+                self.expect_kw("and")
+                self.expect_kw("current")
+                self.expect_kw("row")
             self.expect_op(")")
-            return ast.WindowCall(fc, partition_by, order_by)
+            return ast.WindowCall(fc, partition_by, order_by, frame)
         return fc
 
 
