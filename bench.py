@@ -62,6 +62,14 @@ def main() -> None:
     if "--quick" in sys.argv:
         SF = min(SF, 0.01)
     sys.path.insert(0, REPO)
+    # persistent XLA compile cache: repeated bench runs skip the ~40s
+    # per-query first-compile on the real TPU.  jax is pre-imported by
+    # sitecustomize in this image, so env vars are too late — use config.
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(CACHE, "xla_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     wh = _ensure_warehouse()
 
     from ndstpu.engine.session import Session
@@ -70,9 +78,8 @@ def main() -> None:
 
     queries = []
     for tpl in streamgen.list_templates():
-        sql = streamgen.render_template(
-            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
-        queries.append((tpl, sql))
+        queries.extend(streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
 
     catalog = loader.load_catalog(wh)
     cpu_sess = Session(catalog, backend="cpu")

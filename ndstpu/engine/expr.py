@@ -212,6 +212,26 @@ def common_type(a: DType, b: DType) -> DType:
     return INT32
 
 
+def coerce_in_values(ctype: DType, values) -> Tuple[list, bool]:
+    """Coerce untyped string IN-list literals to a non-string operand
+    column's domain (SQL implicit cast: `d_date in ('2000-06-30', ...)`).
+    A literal that fails the cast is NULL in SQL: dropped from the match
+    set (it can never compare equal), but reported via the second return
+    so NOT IN can apply NULL semantics (never TRUE).  Shared by both the
+    numpy and JAX evaluators so the backends agree."""
+    out, had_null = [], False
+    for v in values:
+        if isinstance(v, str):
+            try:
+                v = columnar.parse_date_days(v) if ctype.kind == "date" \
+                    else float(v)
+            except ValueError:
+                had_null = True
+                continue
+        out.append(v)
+    return out, had_null
+
+
 def cast_column(c: Column, target: DType) -> Column:
     k, tk = c.ctype.kind, target.kind
     if k == tk and (tk != "decimal" or c.ctype.scale == target.scale):
@@ -587,6 +607,7 @@ class Evaluator:
 
     def _in_list(self, e: InList) -> Column:
         c = self.eval(e.operand)
+        had_null = False
         if c.ctype.kind == "string":
             vals = set(str(v) for v in e.values)
             hit_codes = np.array(
@@ -599,9 +620,12 @@ class Evaluator:
                                dtype=np.int64)
             data = np.isin(c.data, targets)
         else:
-            data = np.isin(c.data, np.array(list(e.values)))
+            vals, had_null = coerce_in_values(c.ctype, e.values)
+            data = np.isin(c.data, np.array(vals)) if vals else \
+                np.zeros(len(c.data), dtype=bool)
         if e.negated:
-            data = ~data
+            # x NOT IN (..., NULL) is never TRUE (NULL semantics)
+            data = np.zeros_like(data) if had_null else ~data
         v = c.validity()
         return Column(data, BOOL, None if v.all() else v)
 

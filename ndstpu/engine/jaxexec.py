@@ -576,6 +576,7 @@ class JEval:
 
     def _in_list(self, e: ex.InList) -> DCol:
         c = self.eval(e.operand)
+        had_null = False
         if c.ctype.kind == "string":
             vals = set(str(v) for v in e.values)
             data = _dict_lookup_bool(c, lambda s: s in vals)
@@ -586,9 +587,18 @@ class JEval:
                          dtype=np.int64))
             data = jnp.isin(c.data, targets)
         else:
-            data = jnp.isin(c.data, jnp.asarray(np.array(list(e.values))))
+            vals, had_null = ex.coerce_in_values(c.ctype, e.values)
+            if not vals:
+                data = jnp.zeros(c.capacity, bool)
+            else:
+                arr = np.asarray(vals)
+                if arr.dtype == object or arr.dtype.kind in "US":
+                    raise Unsupported(f"IN-list literals {arr.dtype} for "
+                                      f"{c.ctype.kind} column")
+                data = jnp.isin(c.data, jnp.asarray(arr))
         if e.negated:
-            data = ~data
+            # x NOT IN (..., NULL) is never TRUE (NULL semantics)
+            data = jnp.zeros_like(data) if had_null else ~data
         return DCol(data, c.valid, BOOL)
 
     # -- functions -----------------------------------------------------------
@@ -1553,7 +1563,8 @@ class JaxExecutor:
         # bottom block: null left columns + unmatched right rows
         bottom_cols: Dict[str, DCol] = {}
         for n, c in lt.columns.items():
-            bottom_cols[n] = DCol(jnp.zeros_like(c.data),
+            # null left columns sized to the bottom block's (right) capacity
+            bottom_cols[n] = DCol(jnp.zeros(rt.capacity, c.data.dtype),
                                   jnp.zeros(rt.capacity, bool), c.ctype,
                                   c.dictionary)
         for n, c in rt.columns.items():

@@ -82,6 +82,21 @@ def render_template(template_path: str, rngseed: str, stream: int) -> str:
     return body
 
 
+def render_template_parts(template_path: str, rngseed: str,
+                          stream: int) -> List[Tuple[str, str]]:
+    """Render a template and split multi-statement bodies into the
+    reference's `_part1`/`_part2` naming (nds_gen_query_stream.py:91-103):
+    single-statement -> [("queryN", sql)]; two-part -> two entries."""
+    name = Path(template_path).name
+    base = name[:-4] if name.endswith(".tpl") else name
+    sql = render_template(template_path, rngseed, stream)
+    stmts = [s.strip() for s in sql.split(";") if s.strip()]
+    if len(stmts) <= 1:
+        return [(base, sql)]
+    return [(f"{base}_part{k}", stmt + ";")
+            for k, stmt in enumerate(stmts, 1)]
+
+
 def _query_order(templates: List[str], rngseed: str,
                  stream: int) -> List[str]:
     """Stream 0 = canonical order (the Power Run); streams >= 1 get a
@@ -142,13 +157,12 @@ def generate_single_template(template: str, template_dir: Optional[str],
                 f"{sql}\n"
                 f"-- end query 1 in stream 0 using template {name}\n")
     out_paths = [stream_path]
-    stmts = [s.strip() for s in sql.split(";") if s.strip()]
-    base = name[:-4]
-    if len(stmts) > 1:
-        for k, stmt in enumerate(stmts, 1):
-            p = os.path.join(output_dir, f"{base}_part{k}.sql")
+    parts = render_template_parts(str(d / name), rngseed, 0)
+    if len(parts) > 1:
+        for part_name, stmt in parts:
+            p = os.path.join(output_dir, f"{part_name}.sql")
             with open(p, "w") as f:
-                f.write(stmt + ";\n")
+                f.write(stmt.rstrip(";").rstrip() + ";\n")
             out_paths.append(p)
     return out_paths
 

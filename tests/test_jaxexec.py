@@ -104,12 +104,12 @@ def assert_tables_match(t_cpu, t_tpu, ordered=False):
 
 @pytest.mark.parametrize("tpl", streamgen.list_templates())
 def test_template_differential(cpu_sess, tpu_sess, tpl):
-    sql = streamgen.render_template(
-        str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
-    out_cpu = cpu_sess.sql(sql)
-    out_tpu = tpu_sess.sql(sql)
-    assert out_cpu.column_names == out_tpu.column_names
-    assert_tables_match(out_cpu, out_tpu)
+    for _name, sql in streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+        out_cpu = cpu_sess.sql(sql)
+        out_tpu = tpu_sess.sql(sql)
+        assert out_cpu.column_names == out_tpu.column_names
+        assert_tables_match(out_cpu, out_tpu)
 
 
 def _both(cpu_sess, tpu_sess, sql, ordered=False):
@@ -184,6 +184,25 @@ def test_semi_anti_via_in(cpu_sess, tpu_sess):
           "(select i_item_sk from item where i_category = 'Music')")
 
 
+def test_in_list_untyped_date_literals(cpu_sess, tpu_sess):
+    # plain string literals against a DATE column must coerce on BOTH
+    # backends (query83 shape); result is non-empty so a silent
+    # no-match bug can't hide
+    out = _both(cpu_sess, tpu_sess,
+                "select d_date, d_year from date_dim where d_date in "
+                "('2000-06-30', '2000-09-27', '2000-11-17')")
+    assert len(out.to_rows()) == 3
+    # an uncoercible literal casts to NULL and never matches
+    _both(cpu_sess, tpu_sess,
+          "select count(*) as n from date_dim where d_date in "
+          "('2000-06-30', 'not-a-date')")
+    # NOT IN with a NULL-casting literal is never TRUE (NULL semantics)
+    out = _both(cpu_sess, tpu_sess,
+                "select count(*) as n from date_dim where d_date not in "
+                "('2000-06-30', 'not-a-date')")
+    assert out.to_rows()[0][0] == 0
+
+
 def test_empty_result(cpu_sess, tpu_sess):
     _both(cpu_sess, tpu_sess,
           "select ss_item_sk, ss_quantity from store_sales "
@@ -238,12 +257,12 @@ def test_corpus_compile_coverage(catalog):
     sess = Session(catalog, backend="tpu")
     compiled, fallback = [], []
     for tpl in streamgen.list_templates():
-        sql = streamgen.render_template(
-            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
-        sess.sql(sql)
-        cp = sess.compiled_plan(sql)
-        (compiled if cp is not None and cp.compilable
-         else fallback).append(tpl)
+        for name, sql in streamgen.render_template_parts(
+                str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+            sess.sql(sql)
+            cp = sess.compiled_plan(sql)
+            (compiled if cp is not None and cp.compilable
+             else fallback).append(name)
     assert len(compiled) >= 0.8 * (len(compiled) + len(fallback)), \
         f"too many fallbacks: {fallback}"
 

@@ -42,10 +42,10 @@ def test_corpus_inventory():
 
 @pytest.mark.parametrize("tpl", streamgen.list_templates())
 def test_template_executes(sess, tpl):
-    sql = streamgen.render_template(
-        str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
-    out = sess.sql(sql)
-    assert out is not None and out.column_names
+    for _name, sql in streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+        out = sess.sql(sql)
+        assert out is not None and out.column_names
 
 
 def test_stream_markers_and_parse_contract(tmp_path):
