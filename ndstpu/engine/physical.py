@@ -456,26 +456,35 @@ class Executor:
         c = ex.Evaluator(t).eval(a.arg)
         valid = c.validity()
         if a.distinct:
-            # keep one row per (gid, value)
-            comp = np.stack([gids, c.data.astype(np.int64)], axis=1) \
-                if n else np.zeros((0, 2), dtype=np.int64)
-            comp = comp[valid]
-            if len(comp):
-                _, uidx = np.unique(comp, axis=0, return_index=True)
-                sel = np.zeros(len(comp), dtype=bool)
-                sel[uidx] = True
-                sub_g = comp[sel, 0]
-                sub_v = comp[sel, 1]
+            # keep one row per (gid, value); the dedup key must not lose
+            # precision — float64 dedups on its bit pattern (matching the
+            # device path's _key_i64), never an int cast
+            vidx = np.nonzero(valid)[0] if n else np.zeros(0, np.int64)
+            g = gids[vidx]
+            v = c.data[vidx]
+            if c.ctype.kind == "float64":
+                # bit-pattern key, but -0.0 folds onto +0.0 (SQL equality;
+                # matches the device path's _key_i64)
+                key = np.where(v == 0, np.int64(0), v.view(np.int64))
             else:
-                sub_g = np.zeros(0, dtype=np.int64)
-                sub_v = np.zeros(0, dtype=np.int64)
+                key = v.astype(np.int64)
+            comp = np.stack([g, key], axis=1) if len(vidx) else \
+                np.zeros((0, 2), dtype=np.int64)
+            _, uidx = np.unique(comp, axis=0, return_index=True)
+            sub_g = g[uidx]
+            sub_v = v[uidx]
             if func == "count":
                 counts = np.bincount(sub_g, minlength=ngroups)
                 return Column(counts.astype(np.int64), INT64)
+            got = np.bincount(sub_g, minlength=ngroups) > 0
             if func == "sum":
-                sums = np.bincount(sub_g, weights=sub_v.astype(np.float64),
-                                   minlength=ngroups)
-                got = np.bincount(sub_g, minlength=ngroups) > 0
+                if c.ctype.kind in ("decimal", "int32", "int64"):
+                    sums = np.zeros(ngroups, dtype=np.int64)
+                    np.add.at(sums, sub_g, sub_v.astype(np.int64))
+                else:
+                    sums = np.bincount(
+                        sub_g, weights=sub_v.astype(np.float64),
+                        minlength=ngroups)
                 return self._sum_result(c, sums, got)
             if func == "avg":
                 sums = np.bincount(sub_g, weights=sub_v.astype(np.float64),

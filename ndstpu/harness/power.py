@@ -104,6 +104,32 @@ def load_properties(filename: str) -> Dict[str, str]:
     return props
 
 
+def apply_engine_properties(engine_conf: Dict[str, str]) -> None:
+    """Apply `jax.*` properties to jax.config (the effective engine-knob
+    channel — the analog of Spark conf flowing from the submit template
+    into the SparkSession, nds_power.py:221-237).  Env vars cannot work
+    here: jax is pre-imported by the image's sitecustomize."""
+    jax_keys = {k: v for k, v in engine_conf.items() if k.startswith("jax.")}
+    if not jax_keys:
+        return
+    import jax
+    for k, v in jax_keys.items():
+        name = "jax_" + k[len("jax."):]
+        val: object = v
+        for conv in (int, float):
+            try:
+                val = conv(v)
+                break
+            except ValueError:
+                continue
+        if v.lower() in ("true", "false"):
+            val = v.lower() == "true"
+        try:
+            jax.config.update(name, val)
+        except Exception as e:  # unknown knob: record, don't abort the run
+            print(f"WARNING: engine property {k}={v} not applied: {e}")
+
+
 def run_query_stream(args) -> None:
     total_start = time.time()
     execution_times = []
@@ -114,6 +140,7 @@ def run_query_stream(args) -> None:
         engine_conf.update(load_properties(args.property_file))
     engine_conf.setdefault("engine", args.engine)
     engine_conf.setdefault("input_format", args.input_format)
+    apply_engine_properties(engine_conf)
 
     query_dict = gen_sql_from_stream(args.query_stream_file)
 

@@ -158,6 +158,40 @@ def test_maintenance_insert_delete_and_rollback(dataset, env, tmp_path):
     assert restored == before
 
 
+def test_submit_template_layer(dataset, env, tmp_path):
+    """ndstpu-submit sources a template and launches the phase CLI with
+    the template's engine args (analog: spark-submit-template)."""
+    time_log = tmp_path / "time.csv"
+    subprocess.run(
+        ["./ndstpu/harness/ndstpu-submit", "power_run_cpu.template",
+         str(dataset / "streams" / "query_0.sql"),
+         str(dataset / "wh"), str(time_log),
+         "--input_format", "ndslake",
+         "--sub_queries", "query42",
+         "--json_summary_folder", str(tmp_path / "json")],
+        check=True, env=env)
+    assert "query42" in time_log.read_text()
+    # the template's property file lands in the JSON summary engine conf
+    summary = json.loads(
+        next((tmp_path / "json").glob("cpu-query42-*.json")).read_text())
+    assert summary["env"]["engineConf"]["engine.interpreter"] == "numpy"
+
+
+def test_apply_engine_properties_jax_keys():
+    from ndstpu.harness.power import apply_engine_properties
+    import jax
+    old = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        apply_engine_properties({
+            "jax.persistent_cache_min_compile_time_secs": "0.5",
+            "jax.unknown_knob_xyz": "1",   # warns, must not raise
+            "engine.interpreter": "numpy",
+        })
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.5
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", old)
+
+
 def test_gen_sql_from_stream_contract(tmp_path):
     stream = tmp_path / "s.sql"
     stream.write_text(

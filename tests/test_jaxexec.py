@@ -250,6 +250,74 @@ def test_full_and_right_joins(cpu_sess, tpu_sess):
           "ss_ticket_number where ss_quantity > 45")
 
 
+def test_distinct_aggregates_on_device(cpu_sess, tpu_sess):
+    _both(cpu_sess, tpu_sess,
+          "select ss_store_sk, count(distinct ss_item_sk) as di, "
+          "sum(distinct ss_quantity) as sq, "
+          "avg(distinct ss_wholesale_cost) as aw, "
+          "count(ss_item_sk) as ci "
+          "from store_sales group by ss_store_sk")
+
+
+def test_distinct_aggregate_float_no_truncation(cpu_sess, tpu_sess):
+    # distinct dedup must key on exact float values (bit pattern), not an
+    # int cast; 1.5-scaling makes truncation merge distinct values
+    out = _both(cpu_sess, tpu_sess,
+                "select ss_store_sk, "
+                "sum(distinct ss_wholesale_cost * 1.5) as s, "
+                "count(distinct ss_wholesale_cost * 1.5) as c "
+                "from store_sales group by ss_store_sk")
+    rows = out.to_rows()
+    assert any(r[1] is not None and r[1] != int(r[1]) for r in rows
+               if r[1] is not None), "expected non-integer distinct sums"
+
+
+def test_string_concat_on_device(cpu_sess, tpu_sess):
+    # literal || column (q5/q80 shape)
+    _both(cpu_sess, tpu_sess,
+          "select 'store' || s_store_id as id from store")
+    # column || literal || column (q84 shape) + concat() function
+    _both(cpu_sess, tpu_sess,
+          "select coalesce(c_last_name, '') || ', ' || "
+          "coalesce(c_first_name, '') as customername, "
+          "concat('id:', c_customer_id) as cid from customer")
+
+
+def test_running_window_range_vs_rows(cpu_sess, tpu_sess):
+    # RANGE (default): peer rows share the run value; ROWS: per-row
+    _both(cpu_sess, tpu_sess,
+          "select ss_store_sk, ss_sold_date_sk, "
+          "sum(ss_quantity) over (partition by ss_store_sk "
+          "order by ss_sold_date_sk) as run_range, "
+          "sum(ss_quantity) over (partition by ss_store_sk "
+          "order by ss_sold_date_sk rows between unbounded preceding "
+          "and current row) as run_rows, "
+          "max(ss_quantity) over (partition by ss_store_sk "
+          "order by ss_sold_date_sk) as run_max "
+          "from store_sales where ss_store_sk is not null "
+          "and ss_sold_date_sk is not null")
+
+
+def test_multi_key_join_no_radix_overflow(cpu_sess, tpu_sess):
+    # 4-key equi-join exercises the composite-key re-densify path
+    _both(cpu_sess, tpu_sess,
+          "select count(*) as n from store_sales ss join store_returns sr "
+          "on ss.ss_item_sk = sr.sr_item_sk "
+          "and ss.ss_ticket_number = sr.sr_ticket_number "
+          "and ss.ss_customer_sk = sr.sr_customer_sk "
+          "and ss.ss_store_sk = sr.sr_store_sk")
+
+
+def test_exists_under_or_mark_join(cpu_sess, tpu_sess):
+    # q10/q35 shape: EXISTS subqueries under OR -> mark join on device
+    _both(cpu_sess, tpu_sess,
+          "select c_customer_sk from customer c where "
+          "exists (select * from store_sales where ss_customer_sk = "
+          "c.c_customer_sk and ss_quantity > 10) or "
+          "exists (select * from web_sales where ws_bill_customer_sk = "
+          "c.c_customer_sk)")
+
+
 def test_corpus_compile_coverage(catalog):
     """Most corpus templates must compile to single XLA programs (no
     numpy fallback) — fallbacks are allowed but should be the minority."""
@@ -263,8 +331,8 @@ def test_corpus_compile_coverage(catalog):
             cp = sess.compiled_plan(sql)
             (compiled if cp is not None and cp.compilable
              else fallback).append(name)
-    assert len(compiled) >= 0.8 * (len(compiled) + len(fallback)), \
-        f"too many fallbacks: {fallback}"
+    assert not fallback, \
+        f"corpus queries falling back to numpy: {fallback}"
 
 
 def test_compiled_replay_path(catalog, cpu_sess):
