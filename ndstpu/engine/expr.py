@@ -224,7 +224,15 @@ def coerce_in_values(ctype: DType, values) -> Tuple[list, bool]:
     for v in values:
         try:
             if ctype.kind == "decimal":
-                v = round(float(v) * 10 ** ctype.scale)
+                if isinstance(v, str):
+                    try:
+                        v = int(v)  # exact for integral literals > 2^53
+                    except ValueError:
+                        v = float(v)
+                if isinstance(v, int):
+                    v = v * 10 ** ctype.scale
+                else:
+                    v = round(float(v) * 10 ** ctype.scale)
             elif isinstance(v, str):
                 if ctype.kind == "date":
                     v = columnar.parse_date_days(v)

@@ -617,6 +617,12 @@ class JEval:
             return DCol(jnp.full(self.cap, -1, jnp.int32),
                         jnp.zeros(self.cap, bool), STRING,
                         np.empty(0, object))
+        def encode(vals: np.ndarray):
+            uniq, remap = np.unique(vals, return_inverse=True)
+            table = jnp.asarray(np.concatenate(
+                [remap.astype(np.int64), [-1]]).astype(np.int32))
+            return uniq.astype(object), table
+
         if na == 1 or nb == 1:
             if nb == 1:
                 base, vals = a, np.char.add(da.astype(str),
@@ -624,21 +630,15 @@ class JEval:
             else:
                 base, vals = b, np.char.add(str(da[0]),
                                             db.astype(str))
-            uniq = np.unique(vals)
-            remap = np.searchsorted(uniq, vals).astype(np.int32)
-            table = jnp.asarray(np.concatenate([remap, [-1]])
-                                .astype(np.int32))
+            uniq, table = encode(vals)
             data = jnp.where(valid, table[base.data], -1)
-            return DCol(data, valid, STRING, uniq.astype(object))
+            return DCol(data, valid, STRING, uniq)
         if na * nb > (1 << 20):
             raise Unsupported("|| dictionary cross-product too large")
-        pairs = np.char.add(np.repeat(da.astype(str), nb),
-                            np.tile(db.astype(str), na))
-        uniq = np.unique(pairs)
-        remap = np.searchsorted(uniq, pairs).astype(np.int32)
-        table = jnp.asarray(np.concatenate([remap, [-1]]).astype(np.int32))
+        uniq, table = encode(np.char.add(np.repeat(da.astype(str), nb),
+                                         np.tile(db.astype(str), na)))
         pair = jnp.where(valid, a.data * nb + b.data, na * nb)
-        return DCol(table[pair], valid, STRING, uniq.astype(object))
+        return DCol(table[pair], valid, STRING, uniq)
 
     # -- functions -----------------------------------------------------------
 

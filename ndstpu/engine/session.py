@@ -22,7 +22,12 @@ class Session:
     views: Dict[str, lp.Plan] = field(default_factory=dict)
     # ndslake warehouse root for ACID INSERT/DELETE passthrough (maintenance)
     warehouse: Optional[str] = None
-    backend: str = "cpu"  # cpu | tpu (tpu falls back per-plan when needed)
+    # cpu | tpu | tpu-spmd (tpu falls back per-plan when needed; tpu-spmd
+    # runs the distributed SPMD executor over the device mesh and falls
+    # back to the single-chip tpu path on unsupported plan shapes)
+    backend: str = "cpu"
+    # tpu-spmd: minimum table rows to shard (None = dplan default)
+    spmd_threshold: Optional[int] = None
     # bumped on view create/drop — part of the compiled-query cache key
     # (same SQL text over a redefined view must not reuse a stale plan)
     _views_epoch: int = 0
@@ -97,13 +102,35 @@ class Session:
 
     def _execute(self, plan: lp.Plan,
                  key: Optional[str] = None) -> columnar.Table:
-        if self.backend == "tpu":
+        if self.backend == "tpu-spmd":
+            from ndstpu.engine import jaxexec
+            from ndstpu.parallel import dplan
+            try:
+                out = dplan.execute_distributed(
+                    self.catalog, self._mesh(), plan,
+                    **({"shard_threshold_rows": self.spmd_threshold}
+                       if self.spmd_threshold is not None else {}))
+                self._spmd_used = True
+                return out
+            except (dplan.DistUnsupported, jaxexec.Unsupported):
+                # plan shape or an expression outside the distributed
+                # subset: the single-chip path below has per-plan fallback
+                pass
+        if self.backend in ("tpu", "tpu-spmd"):
             exe = self._jax_executor()
             if key is not None:
                 return exe.execute_cached(
                     plan, f"{self._views_epoch}|{key}")
             return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
+
+    def _mesh(self):
+        m = getattr(self, "_mesh_cache", None)
+        if m is None:
+            from ndstpu.parallel import mesh as pmesh
+            m = pmesh.default_mesh()
+            self._mesh_cache = m
+        return m
 
     def compiled_plan(self, text: str):
         """The cached whole-query compile record for a SQL text (or None).

@@ -210,8 +210,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--input_format", default="parquet",
                    choices=["parquet", "orc", "csv", "json", "ndslake"],
                    help="warehouse table format")
-    p.add_argument("--engine", default="cpu", choices=["cpu", "tpu"],
-                   help="execution backend")
+    p.add_argument("--engine", default="cpu",
+                   choices=["cpu", "tpu", "tpu-spmd"],
+                   help="execution backend (tpu-spmd distributes over "
+                        "the device mesh, falling back per-query)")
     p.add_argument("--output_prefix",
                    help="write per-query results under this dir "
                         "(for validation); default = collect only")

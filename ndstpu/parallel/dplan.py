@@ -1,0 +1,719 @@
+"""Distributed plan executor: SQL plans as single SPMD XLA programs.
+
+Executes the planner/optimizer's logical plans over a ``jax.sharding.Mesh``
+— the multi-chip analog of Spark's distributed SQL execution (reference:
+executors + shuffle exchange, power_run_cpu.template:23-33) designed
+TPU-first rather than translated:
+
+* The **spine** — the operator chain over the single largest table — runs
+  row-sharded over the mesh's data axis inside ONE ``jit(shard_map)``
+  program: filters/projects are local, dimension joins are broadcast
+  joins (host-resolved build side, searchsorted probe — surrogate keys
+  are ints), aggregation is local sort-grouped partials combined via
+  ``lax.all_gather`` over ICI and re-grouped replicated (exact, no hash
+  collisions; the psum combine for dense keys lives in
+  ndstpu.parallel.dquery, the all_to_all repartition in
+  ndstpu.parallel.exchange).
+* **Build sides and the plan tail** (the tiny part: dimension subtrees,
+  final Sort/Limit/Project over a handful of groups) execute on the host
+  numpy interpreter — the driver side of a broadcast join.
+* Plans without a sharded-size table, or using operators outside the
+  distributed subset, raise :class:`DistUnsupported`; callers fall back
+  to the single-chip engine (ndstpu.engine.jaxexec).
+
+Differentially tested against the numpy interpreter on a virtual
+8-device CPU mesh (tests/test_parallel.py) and compile-checked by the
+driver via __graft_entry__.dryrun_multichip.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ndstpu.engine import columnar, expr as ex, physical, plan as lp
+from ndstpu.engine.columnar import BOOL, FLOAT64, INT64, Column, Table
+from ndstpu.engine.jaxexec import (
+    DCol,
+    DTable,
+    JEval,
+    _DEAD_KEY,
+    _group_ids,
+    _key_i64,
+)
+from ndstpu.parallel.mesh import SHARD_AXIS
+
+
+class DistUnsupported(Exception):
+    """Plan shape outside the distributed subset — fall back single-chip."""
+
+
+_SPINE_NODES = (lp.Scan, lp.Filter, lp.Project, lp.Join, lp.SubqueryAlias)
+_KEY_KINDS = ("int32", "int64", "date")
+_AGG_FUNCS = ("sum", "count", "avg", "min", "max",
+              "stddev_samp", "var_samp", "stddev", "variance")
+
+
+@dataclasses.dataclass
+class _BroadcastJoin:
+    """Host-resolved build side of a spine join (driver-side broadcast)."""
+    kind: str
+    mark: Optional[str]
+    extra: Optional[ex.Expr]
+    probe_key_exprs: List[ex.Expr]
+    radices: List[Tuple[int, int]]   # (lo, span) per key part
+    sorted_keys: np.ndarray          # valid build keys, sorted
+    row_of: np.ndarray               # sorted position -> build row index
+    build: Table                     # host build table (post plan)
+    spine_left: bool                 # spine side is the join's left child
+    build_has_null: bool = False     # any build row with a NULL key part
+    build_empty: bool = False
+
+
+class DistributedPlanExecutor:
+    """Compiles + runs one logical plan over the mesh (one-shot object)."""
+
+    def __init__(self, catalog, mesh, shard_threshold_rows: int = 65536,
+                 broadcast_limit_rows: int = 8_000_000):
+        self.catalog = catalog
+        self.mesh = mesh
+        self.n_dev = int(mesh.devices.size)
+        self.threshold = shard_threshold_rows
+        self.broadcast_limit = broadcast_limit_rows
+        self.np_exec = physical.Executor(catalog)
+        self.joins: Dict[int, _BroadcastJoin] = {}
+        self.fact: Optional[lp.Scan] = None
+        # trace-time metadata side channels (static python values)
+        self._row_meta: Optional[List[tuple]] = None
+        self._key_meta: Optional[List[tuple]] = None
+        self._leaf_meta: Optional[List[tuple]] = None
+
+    # -- public --------------------------------------------------------------
+
+    def execute_plan(self, plan: lp.Plan) -> Table:
+        """Try candidate fact tables largest-first (at tiny scale factors
+        a fixed-size dimension like date_dim can out-size the fact, and
+        some spines fail preparation, e.g. non-unique build keys)."""
+        scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
+        if not scans:
+            raise DistUnsupported("no base-table scan in plan")
+        sized = sorted(((self.catalog.get(n.table).num_rows, i, n)
+                        for i, n in enumerate(scans)),
+                       key=lambda t: (-t[0], t[1]))
+        last: Optional[DistUnsupported] = None
+        for rows, _, target in sized:
+            if rows < self.threshold:
+                break
+            for r, _, n in sized:
+                if n is not target and r > self.broadcast_limit:
+                    raise DistUnsupported(
+                        f"second large table {n.table} ({r} rows) "
+                        "exceeds the broadcast limit (fact-fact join)")
+            self.joins = {}
+            self.fact = None
+            self.fact_target = target
+            try:
+                spine, top = self._split(plan)
+                result = self._run_spine(spine)
+            except DistUnsupported as e:
+                last = e
+                continue
+            if top is None:
+                return result
+            grafted = _graft(top, spine,
+                             lp.InlineTable(result, "__dist__"))
+            return self.np_exec.execute(grafted)
+        raise last or DistUnsupported("no sharded-size table in plan")
+
+    # -- plan analysis -------------------------------------------------------
+
+    def _split(self, plan: lp.Plan) -> Tuple[lp.Plan, Optional[lp.Plan]]:
+        """Find the distributed spine: the chain from the single big Scan
+        up to the first Aggregate above it (or the highest supported node).
+        Returns (spine_head, top_plan); top_plan executes on host over the
+        spine's result (None = the spine is the whole plan)."""
+        target = self.fact_target
+
+        chain: List[lp.Plan] = []
+
+        def descend(node) -> bool:
+            chain.append(node)
+            if node is target:
+                return True
+            for c in node.children():
+                if descend(c):
+                    return True
+            chain.pop()
+            return False
+
+        descend(plan)
+
+        def spine_ok(node) -> bool:
+            if isinstance(node, lp.Join):
+                return node.kind in ("inner", "left", "semi", "anti",
+                                    "nullaware_anti", "mark")
+            return isinstance(node, _SPINE_NODES)
+
+        agg_i = next((i for i, nd in enumerate(chain)
+                      if isinstance(nd, lp.Aggregate)), None)
+        if agg_i is not None:
+            for nd in chain[agg_i + 1:]:
+                if not spine_ok(nd):
+                    raise DistUnsupported(
+                        f"{type(nd).__name__} below spine aggregate")
+            self._check_agg(chain[agg_i])
+            spine = chain[agg_i]
+        else:
+            ok_from = len(chain) - 1
+            for i in range(len(chain) - 1, -1, -1):
+                if spine_ok(chain[i]):
+                    ok_from = i
+                else:
+                    break
+            spine = chain[ok_from]
+        top = plan if spine is not plan else None
+        return spine, top
+
+    def _check_agg(self, node: lp.Aggregate) -> None:
+        if node.grouping_sets is not None:
+            raise DistUnsupported("grouping sets on spine")
+        for _, e in node.aggs:
+            for sub in e.walk():
+                if isinstance(sub, ex.AggExpr):
+                    if sub.distinct:
+                        raise DistUnsupported("distinct agg on spine")
+                    if sub.func not in _AGG_FUNCS:
+                        raise DistUnsupported(f"agg {sub.func} on spine")
+                if isinstance(sub, ex.WindowExpr):
+                    raise DistUnsupported("window inside aggregate")
+
+    # -- spine preparation ---------------------------------------------------
+
+    def _resolve_all(self, p: lp.Plan) -> None:
+        for node in p.walk():
+            if isinstance(node, lp.Scan) and node.predicate is not None:
+                node.predicate = self.np_exec._resolve_subqueries(
+                    node.predicate)
+            elif isinstance(node, lp.Filter):
+                node.condition = self.np_exec._resolve_subqueries(
+                    node.condition)
+            elif isinstance(node, lp.Project):
+                node.exprs = [(n, self.np_exec._resolve_subqueries(e))
+                              for n, e in node.exprs]
+
+    def _prepare(self, p: lp.Plan) -> bool:
+        """True when `p` contains the sharded scan; resolves broadcast-join
+        build sides on the host as it walks."""
+        if isinstance(p, lp.Scan):
+            if p is self.fact_target:
+                self.fact = p
+                return True
+            return False
+        if isinstance(p, lp.Join):
+            on_left = self._prepare(p.left)
+            on_right = False if on_left else self._prepare(p.right)
+            if not (on_left or on_right):
+                return False
+            kind = p.kind
+            if kind not in ("inner", "left", "semi", "anti",
+                            "nullaware_anti", "mark"):
+                raise DistUnsupported(f"{kind} join on spine")
+            keys = list(p.keys)
+            if not keys:
+                raise DistUnsupported("non-equi join on spine")
+            if not on_left:
+                if kind != "inner":
+                    raise DistUnsupported(
+                        f"sharded table on the build side of {kind} join")
+                keys = [(r, l) for l, r in keys]
+            build_plan = p.right if on_left else p.left
+            build = self.np_exec.execute(build_plan)
+            probe_exprs = [l for l, _ in keys]
+            bvalid = np.ones(build.num_rows, dtype=bool)
+            key_parts = []
+            for _, be in keys:
+                c = ex.Evaluator(build).eval(be)
+                if c.ctype.kind not in _KEY_KINDS:
+                    raise DistUnsupported(
+                        f"{c.ctype.kind} join key on spine")
+                key_parts.append(c.data.astype(np.int64))
+                bvalid &= c.validity()
+            bkeys = np.zeros(build.num_rows, dtype=np.int64)
+            radices: List[Tuple[int, int]] = []
+            bound = 1
+            for part in key_parts:
+                lo = int(part.min()) if len(part) else 0
+                hi = int(part.max()) if len(part) else 0
+                span = hi - lo + 2
+                bound *= span
+                if bound >= 2 ** 62:
+                    raise DistUnsupported("composite key domain overflow")
+                radices.append((lo, span))
+                bkeys = bkeys * span + np.clip(part - lo, 0, span - 1) + 1
+            bkeys = np.where(bvalid, bkeys, np.int64(-1))
+            order = np.argsort(bkeys, kind="stable")
+            skeys = bkeys[order]
+            first_valid = int(np.searchsorted(skeys, 0))
+            skeys = skeys[first_valid:]
+            row_of = order[first_valid:]
+            if kind in ("inner", "left") and \
+                    len(np.unique(skeys)) != len(skeys):
+                raise DistUnsupported(
+                    "non-unique build keys for inner/left broadcast join")
+            self.joins[id(p)] = _BroadcastJoin(
+                kind, p.mark, p.extra, probe_exprs, radices, skeys,
+                row_of, build, on_left,
+                build_has_null=bool((~bvalid).any()),
+                build_empty=build.num_rows == 0)
+            return True
+        spine = False
+        for c in p.children():
+            spine = self._prepare(c) or spine
+        return spine
+
+    # -- spine execution -----------------------------------------------------
+
+    def _run_spine(self, spine: lp.Plan) -> Table:
+        agg = spine if isinstance(spine, lp.Aggregate) else None
+        row_head = agg.child if agg is not None else spine
+        self._resolve_all(row_head)
+        if agg is not None:
+            for _, e in agg.aggs + agg.group_by:
+                for sub in e.walk():
+                    if isinstance(sub, ex.SubqueryExpr):
+                        raise DistUnsupported("subquery above row spine")
+        self._prepare(row_head)
+        if self.fact is None:
+            raise DistUnsupported("no sharded scan on spine")
+        fact_table = self.catalog.get(self.fact.table)
+
+        cols = self.fact.columns
+        names = list(cols) if cols is not None else \
+            list(fact_table.column_names)
+        if not names:
+            names = fact_table.column_names[:1]
+        n = fact_table.num_rows
+        m = -(-max(n, 1) // self.n_dev)
+        padded = m * self.n_dev
+
+        flat_args: List[np.ndarray] = []
+        metas = []
+        for name in names:
+            c = fact_table.column(name)
+            data = np.zeros(padded, dtype=c.data.dtype)
+            data[:n] = c.data
+            valid = np.zeros(padded, dtype=bool)
+            valid[:n] = c.validity()
+            flat_args += [data, valid]
+            metas.append((name, c.ctype, c.dictionary))
+        alive = np.zeros(padded, dtype=bool)
+        alive[:n] = True
+        flat_args.append(alive)
+        self._fact_metas = metas
+
+        agg_leaves = self._agg_leaves(agg) if agg is not None else []
+
+        def body(*args):
+            col_args, alive_arg = args[:-1], args[-1]
+            dcols = {}
+            for i, (name, ctype, dictionary) in enumerate(metas):
+                dcols[name] = DCol(col_args[2 * i], col_args[2 * i + 1],
+                                   ctype, dictionary)
+            dt = self._exec(row_head, DTable(dcols, alive_arg))
+            if agg is None:
+                self._row_meta = [(nm, dt.columns[nm].ctype,
+                                   dt.columns[nm].dictionary)
+                                  for nm in dt.column_names]
+                flat = []
+                for nm in dt.column_names:
+                    flat += [dt.columns[nm].data, dt.columns[nm].valid]
+                return tuple(flat) + (dt.alive,)
+            return self._agg_partials(agg, agg_leaves, dt)
+
+        row_sh = NamedSharding(self.mesh, P(SHARD_AXIS))
+        dev_args = [jax.device_put(a, row_sh) for a in flat_args]
+        sharded = shard_map(
+            body, mesh=self.mesh,
+            in_specs=tuple(P(SHARD_AXIS) for _ in flat_args),
+            out_specs=P(SHARD_AXIS) if agg is None else P(),
+            check_vma=False)
+        out = jax.device_get(jax.jit(sharded)(*dev_args))
+
+        if agg is not None:
+            return self._finalize_agg(agg, agg_leaves, out)
+        flat, alive_out = out[:-1], np.asarray(out[-1])
+        sel = np.nonzero(alive_out)[0]
+        res = {}
+        for i, (name, ctype, dictionary) in enumerate(self._row_meta):
+            data = np.asarray(flat[2 * i])[sel]
+            valid = np.asarray(flat[2 * i + 1])[sel]
+            res[name] = Column(data, ctype,
+                               None if valid.all() else valid, dictionary)
+        return Table(res)
+
+    # -- traced operators ----------------------------------------------------
+
+    def _exec(self, p: lp.Plan, dt: DTable) -> DTable:
+        if isinstance(p, lp.Scan):
+            if p.predicate is not None:
+                mask = JEval(dt).predicate(p.predicate)
+                dt = DTable(dt.columns, dt.alive & mask)
+            return dt
+        if isinstance(p, lp.SubqueryAlias):
+            dt = self._exec(p.child, dt)
+            if p.column_aliases:
+                dt = DTable(dict(zip(p.column_aliases,
+                                     dt.columns.values())), dt.alive)
+            return dt
+        if isinstance(p, lp.Filter):
+            dt = self._exec(p.child, dt)
+            mask = JEval(dt).predicate(p.condition)
+            return DTable(dt.columns, dt.alive & mask)
+        if isinstance(p, lp.Project):
+            dt = self._exec(p.child, dt)
+            evl = JEval(dt)
+            return DTable({n: evl.eval(e) for n, e in p.exprs}, dt.alive)
+        if isinstance(p, lp.Join):
+            bj = self.joins.get(id(p))
+            if bj is None:
+                raise DistUnsupported("unprepared join on spine")
+            dt = self._exec(p.left if bj.spine_left else p.right, dt)
+            return self._broadcast_join(bj, dt)
+        raise DistUnsupported(f"{type(p).__name__} in traced spine")
+
+    def _broadcast_join(self, bj: _BroadcastJoin, dt: DTable) -> DTable:
+        evl = JEval(dt)
+        cap = dt.capacity
+        pkey = jnp.zeros(cap, jnp.int64)
+        pnull = jnp.zeros(cap, bool)
+        in_dom = jnp.ones(cap, bool)
+        for e, (lo, span) in zip(bj.probe_key_exprs, bj.radices):
+            c = evl.eval(e)
+            if c.ctype.kind not in _KEY_KINDS:
+                raise DistUnsupported(f"{c.ctype.kind} probe key")
+            part = c.data.astype(jnp.int64)
+            pnull |= ~c.valid
+            in_dom &= (part >= lo) & (part < lo + span - 1)
+            pkey = pkey * span + jnp.clip(part - lo, 0, span - 1) + 1
+        pvalid = ~pnull & in_dom & dt.alive
+        if len(bj.sorted_keys) == 0:
+            found = jnp.zeros(cap, bool)
+            bidx = jnp.zeros(cap, jnp.int64)
+        else:
+            skeys = jnp.asarray(bj.sorted_keys)
+            pos = jnp.searchsorted(skeys, pkey)
+            posc = jnp.clip(pos, 0, len(bj.sorted_keys) - 1)
+            found = (skeys[posc] == pkey) & pvalid
+            bidx = jnp.asarray(bj.row_of)[posc]
+        bcols: Dict[str, DCol] = {}
+        for name in bj.build.column_names:
+            c = bj.build.column(name)
+            data = jnp.asarray(c.data)[bidx]
+            valid = jnp.asarray(c.validity())[bidx] & found
+            bcols[name] = DCol(data, valid, c.ctype, c.dictionary)
+        combined = DTable({**dt.columns, **bcols}, dt.alive)
+        if bj.extra is not None:
+            found = found & JEval(combined).predicate(bj.extra)
+            bcols = {n: DCol(c.data, c.valid & found, c.ctype,
+                             c.dictionary) for n, c in bcols.items()}
+            combined = DTable({**dt.columns, **bcols}, dt.alive)
+        if bj.kind == "inner":
+            return DTable(combined.columns, dt.alive & found)
+        if bj.kind == "left":
+            return combined
+        if bj.kind == "semi":
+            return DTable(dt.columns, dt.alive & found)
+        if bj.kind == "anti":
+            return DTable(dt.columns, dt.alive & ~found)
+        if bj.kind == "nullaware_anti":
+            if bj.extra is not None:
+                raise DistUnsupported("residual on nullaware anti join")
+            if bj.build_has_null:   # NOT IN (... NULL ...): never TRUE
+                return DTable(dt.columns, jnp.zeros(cap, bool))
+            if bj.build_empty:      # NOT IN (empty): keep everything
+                return DTable(dt.columns, dt.alive)
+            return DTable(dt.columns, dt.alive & ~found & ~pnull)
+        # mark
+        cols = dict(dt.columns)
+        cols[bj.mark] = DCol(found, jnp.ones(cap, bool), BOOL)
+        return DTable(cols, dt.alive)
+
+    # -- distributed aggregation ---------------------------------------------
+
+    @staticmethod
+    def _agg_leaves(agg: lp.Aggregate) -> List[ex.AggExpr]:
+        leaves, seen = [], set()
+        for _, e in agg.aggs:
+            for sub in e.walk():
+                if isinstance(sub, ex.AggExpr) and id(sub) not in seen:
+                    seen.add(id(sub))
+                    leaves.append(sub)
+        return leaves
+
+    def _agg_partials(self, agg: lp.Aggregate, leaves, dt: DTable):
+        """Local sort-grouped partials -> all_gather over the mesh ->
+        replicated exact final re-group.  Returns a flat tuple of
+        replicated arrays; names/ctypes captured via side channels."""
+        evl = JEval(dt)
+        cap = dt.capacity
+        key_cols = [(n, evl.eval(e)) for n, e in agg.group_by]
+        self._key_meta = [(n, c.ctype, c.dictionary) for n, c in key_cols]
+        if key_cols:
+            keys = [_key_i64(c, dt.alive) for _, c in key_cols]
+        else:
+            keys = [jnp.where(dt.alive, jnp.int64(0), _DEAD_KEY)]
+        gid, order, newgrp = _group_ids(keys)
+        idx = jnp.arange(cap)
+        first_pos = jnp.full(cap, cap, jnp.int64).at[
+            (jnp.cumsum(newgrp) - 1)].min(idx)
+        rep = order[jnp.clip(first_pos, 0, cap - 1)]
+        slot_used = jnp.zeros(cap, bool).at[gid].set(True)
+        galive = jax.ops.segment_sum(dt.alive.astype(jnp.int32), gid,
+                                     num_segments=cap) > 0
+        out_alive = slot_used & galive
+
+        def gather(x):
+            return lax.all_gather(x, SHARD_AXIS).reshape(
+                (self.n_dev * cap,) + x.shape[1:])
+
+        g_alive = gather(out_alive)
+        g_keys = [gather(jnp.where(out_alive, k[rep], _DEAD_KEY))
+                  for k in keys]
+        g_key_cols = [(gather(c.data[rep]),
+                       gather(c.valid[rep] & out_alive))
+                      for _, c in key_cols]
+
+        self._leaf_meta = []
+        g_leaves = []
+        for a in leaves:
+            parts, meta = self._leaf_partial(dt, evl, a, gid, cap)
+            self._leaf_meta.append(meta)
+            g_leaves.append([gather(p) for p in parts])
+
+        # replicated exact final re-group over n_dev * cap slots
+        total = self.n_dev * cap
+        fgid, forder, fnew = _group_ids(g_keys)
+        fidx = jnp.arange(total)
+        ffirst = jnp.full(total, total, jnp.int64).at[
+            (jnp.cumsum(fnew) - 1)].min(fidx)
+        frep = forder[jnp.clip(ffirst, 0, total - 1)]
+        fused = jnp.zeros(total, bool).at[fgid].set(True)
+        fal = jax.ops.segment_sum(g_alive.astype(jnp.int32), fgid,
+                                  num_segments=total) > 0
+        final_alive = fused & fal
+
+        flat = [final_alive]
+        for gdata, gvalid in g_key_cols:
+            flat += [gdata[frep], gvalid[frep] & final_alive]
+        for a, parts in zip(leaves, g_leaves):
+            flat += self._combine_partials(a, parts, fgid, total, g_alive)
+        return tuple(flat)
+
+    def _leaf_partial(self, dt: DTable, evl: JEval, a: ex.AggExpr, gid,
+                      cap):
+        """Per-slot partial arrays + static meta for one leaf aggregate."""
+        alive = dt.alive
+        if isinstance(a.arg, ex.Star) or a.arg is None:
+            cnt = jax.ops.segment_sum(alive.astype(jnp.int64), gid,
+                                      num_segments=cap)
+            return [cnt], (a.func, None, None)
+        c = evl.eval(a.arg)
+        meta = (a.func, c.ctype, c.dictionary)
+        valid = c.valid & alive
+        cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                  num_segments=cap)
+        if a.func == "count":
+            return [cnt], meta
+        if a.func in ("sum", "avg"):
+            if c.ctype.kind in ("decimal", "int32", "int64"):
+                s = jax.ops.segment_sum(
+                    jnp.where(valid, c.data.astype(jnp.int64), 0), gid,
+                    num_segments=cap)
+            else:
+                s = jax.ops.segment_sum(
+                    jnp.where(valid, c.data.astype(jnp.float64), 0.0),
+                    gid, num_segments=cap)
+            return [s, cnt], meta
+        if a.func in ("min", "max"):
+            if c.ctype.kind == "float64":
+                init = jnp.inf if a.func == "min" else -jnp.inf
+                vals = jnp.where(valid, c.data, init)
+            else:
+                init = _DEAD_KEY if a.func == "min" else -_DEAD_KEY
+                vals = jnp.where(valid, c.data.astype(jnp.int64),
+                                 jnp.int64(init))
+            seg = jax.ops.segment_min if a.func == "min" \
+                else jax.ops.segment_max
+            return [seg(vals, gid, num_segments=cap), cnt], meta
+        # stddev family
+        x = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
+        if c.ctype.kind == "decimal":
+            x = x / (10 ** c.ctype.scale)
+        s1 = jax.ops.segment_sum(x, gid, num_segments=cap)
+        s2 = jax.ops.segment_sum(x * x, gid, num_segments=cap)
+        return [s1, s2, cnt], meta
+
+    def _combine_partials(self, a: ex.AggExpr, parts, fgid, total,
+                          g_alive):
+        out = []
+        minmax = a.func in ("min", "max")
+        for pi, part in enumerate(parts):
+            if minmax and pi == 0:
+                seg = jax.ops.segment_min if a.func == "min" \
+                    else jax.ops.segment_max
+                if part.dtype == jnp.float64:
+                    init = jnp.inf if a.func == "min" else -jnp.inf
+                else:
+                    init = jnp.int64(
+                        _DEAD_KEY if a.func == "min" else -_DEAD_KEY)
+                vals = jnp.where(g_alive, part, init)
+                out.append(seg(vals, fgid, num_segments=total))
+            else:
+                vals = jnp.where(g_alive, part,
+                                 jnp.zeros((), part.dtype))
+                out.append(jax.ops.segment_sum(vals, fgid,
+                                               num_segments=total))
+        return out
+
+    # -- host finalize -------------------------------------------------------
+
+    _PARTS_PER_FUNC = {"count": 1, "sum": 2, "avg": 2, "min": 2, "max": 2,
+                       "stddev_samp": 3, "var_samp": 3, "stddev": 3,
+                       "variance": 3}
+
+    def _finalize_agg(self, agg: lp.Aggregate, leaves, out) -> Table:
+        flat = [np.asarray(a) for a in out]
+        final_alive = flat[0]
+        sel = np.nonzero(final_alive)[0]
+        pos = 1
+        key_cols: Dict[str, Column] = {}
+        for name, ctype, dictionary in self._key_meta:
+            data, valid = flat[pos][sel], flat[pos + 1][sel]
+            pos += 2
+            key_cols[name] = Column(
+                data, ctype, None if valid.all() else valid, dictionary)
+        leaf_final: Dict[int, Column] = {}
+        for li, (a, meta) in enumerate(zip(leaves, self._leaf_meta)):
+            func, ctype, dictionary = meta
+            nparts = self._PARTS_PER_FUNC[func] if not (
+                isinstance(a.arg, ex.Star) or a.arg is None) else 1
+            parts = [flat[pos + k][sel] for k in range(nparts)]
+            pos += nparts
+            leaf_final[li] = self._finalize_leaf(a, meta, parts)
+
+        if not agg.group_by and len(sel) == 0:
+            # SQL global aggregate over zero rows: one row, count 0 / NULL
+            for li, (a, meta) in enumerate(zip(leaves, self._leaf_meta)):
+                c = leaf_final[li]
+                if a.func == "count":
+                    leaf_final[li] = Column(
+                        np.zeros(1, np.int64), INT64)
+                else:
+                    leaf_final[li] = Column(
+                        np.zeros(1, c.data.dtype), c.ctype,
+                        np.zeros(1, bool), c.dictionary)
+
+        sub_cols = {f"__agg{li}": c for li, c in leaf_final.items()}
+        gtable = Table({**key_cols, **sub_cols})
+        out_cols: Dict[str, Column] = {}
+        for name, _ in agg.group_by:
+            out_cols[name] = key_cols[name]
+        for name, e in agg.aggs:
+            out_cols[name] = ex.Evaluator(gtable).eval(
+                self._lower_expr(e, leaves))
+        return Table(out_cols)
+
+    def _lower_expr(self, e: ex.Expr, leaves) -> ex.Expr:
+        for li, a in enumerate(leaves):
+            if a is e:
+                return ex.ColumnRef(f"__agg{li}")
+        if isinstance(e, ex.BinOp):
+            return ex.BinOp(e.op, self._lower_expr(e.left, leaves),
+                            self._lower_expr(e.right, leaves))
+        if isinstance(e, ex.UnaryOp):
+            return ex.UnaryOp(e.op, self._lower_expr(e.operand, leaves))
+        if isinstance(e, ex.Cast):
+            return ex.Cast(self._lower_expr(e.operand, leaves), e.target)
+        if isinstance(e, ex.Func):
+            return ex.Func(e.name, tuple(self._lower_expr(a, leaves)
+                                         for a in e.args))
+        if isinstance(e, ex.Case):
+            return ex.Case(
+                tuple((self._lower_expr(c, leaves),
+                       self._lower_expr(v, leaves)) for c, v in e.whens),
+                self._lower_expr(e.default, leaves)
+                if e.default is not None else None)
+        return e
+
+    def _finalize_leaf(self, a: ex.AggExpr, meta, parts) -> Column:
+        func, ctype, dictionary = meta
+        if isinstance(a.arg, ex.Star) or a.arg is None or func == "count":
+            return Column(parts[0].astype(np.int64), INT64)
+        if func == "sum":
+            s, cnt = parts
+            got = cnt > 0
+            vopt = None if got.all() else got
+            if ctype.kind == "decimal":
+                return Column(s.astype(np.int64),
+                              columnar.decimal(38, ctype.scale), vopt)
+            if ctype.kind in ("int32", "int64"):
+                return Column(s.astype(np.int64), INT64, vopt)
+            return Column(s.astype(np.float64), FLOAT64, vopt)
+        if func == "avg":
+            s, cnt = parts
+            got = cnt > 0
+            mean = s.astype(np.float64) / np.maximum(cnt, 1)
+            if ctype.kind == "decimal":
+                mean = mean / (10 ** ctype.scale)
+            return Column(mean, FLOAT64, None if got.all() else got)
+        if func in ("min", "max"):
+            v, cnt = parts
+            got = cnt > 0
+            vopt = None if got.all() else got
+            if ctype.kind == "float64":
+                return Column(v.astype(np.float64), ctype, vopt)
+            dtype = columnar.numpy_dtype(ctype)
+            return Column(v.astype(dtype), ctype, vopt, dictionary)
+        # stddev family
+        s1, s2, cnt = parts
+        ok = cnt > 1
+        denom = np.where(ok, cnt - 1, 1)
+        var = np.maximum(
+            s2 - np.where(cnt > 0, s1 * s1 / np.maximum(cnt, 1), 0.0),
+            0.0) / denom
+        data = var if func in ("var_samp", "variance") else np.sqrt(var)
+        return Column(data, FLOAT64, None if ok.all() else ok)
+
+
+def _graft(top: lp.Plan, old: lp.Plan, new: lp.Plan) -> lp.Plan:
+    """Copy of `top` with the subtree `old` replaced by `new`."""
+    if top is old:
+        return new
+    n = copy.copy(top)
+    for attr in ("child", "left", "right"):
+        c = getattr(n, attr, None)
+        if c is not None:
+            setattr(n, attr, _graft(c, old, new))
+    return n
+
+
+def execute_distributed(catalog, mesh, plan: lp.Plan,
+                        shard_threshold_rows: int = 65536,
+                        broadcast_limit_rows: int = 8_000_000) -> Table:
+    """One-shot helper: run `plan` over `mesh`, DistUnsupported on plans
+    outside the distributed subset."""
+    return DistributedPlanExecutor(
+        catalog, mesh, shard_threshold_rows,
+        broadcast_limit_rows).execute_plan(plan)
