@@ -105,11 +105,26 @@ class Session:
         if self.backend == "tpu-spmd":
             from ndstpu.engine import jaxexec
             from ndstpu.parallel import dplan
+            versions = tuple(sorted(
+                getattr(self.catalog, "versions", {}).items()))
+            cache = getattr(self, "_spmd_cache", None)
+            if cache is None:
+                cache = self._spmd_cache = {}
+                self._spmd_dev_cache = {}
+            ck = f"{self._views_epoch}|{key}" if key is not None else None
+            ent = cache.get(ck) if ck else None
+            if ent is not None and ent[0] == versions:
+                self._spmd_used = True
+                return ent[1].execute_again()
             try:
-                out = dplan.execute_distributed(
-                    self.catalog, self._mesh(), plan,
-                    **({"shard_threshold_rows": self.spmd_threshold}
-                       if self.spmd_threshold is not None else {}))
+                kw = {"dev_cache": self._spmd_dev_cache}
+                if self.spmd_threshold is not None:
+                    kw["shard_threshold_rows"] = self.spmd_threshold
+                exe = dplan.DistributedPlanExecutor(
+                    self.catalog, self._mesh(), **kw)
+                out = exe.execute_plan(plan)
+                if ck:
+                    cache[ck] = (versions, exe)
                 self._spmd_used = True
                 return out
             except (dplan.DistUnsupported, jaxexec.Unsupported):

@@ -103,12 +103,15 @@ def get_maintenance_time(report_base: str, num_streams: int,
 
 def get_perf_metric(scale_factor, num_streams_in_throughput, queries_per_stream,
                     Tload, Tpower, Ttt1, Ttt2, Tdm1, Tdm2) -> int:
-    """Composite metric, times in decimal hours (nds_bench.py:334-357)."""
+    """Composite metric, times in decimal hours (nds_bench.py:334-357).
+    Each component is clamped to the 0.1s rounding floor so a phase that
+    measures 0 elapsed at tiny scale factors cannot zero the product
+    (unreachable at spec-scale; the reference rounds to 0.1s upstream)."""
     Q = num_streams_in_throughput * queries_per_stream
-    Tpt = (Tpower * num_streams_in_throughput) / 3600
-    Ttt = (Ttt1 + Ttt2) / 3600
-    Tdm = (Tdm1 + Tdm2) / 3600
-    Tld = (0.01 * num_streams_in_throughput * Tload) / 3600
+    Tpt = max(Tpower * num_streams_in_throughput, 0.1) / 3600
+    Ttt = max(Ttt1 + Ttt2, 0.1) / 3600
+    Tdm = max(Tdm1 + Tdm2, 0.1) / 3600
+    Tld = max(0.01 * num_streams_in_throughput * Tload, 0.1) / 3600
     return int(float(scale_factor) * Q / (Tpt * Ttt * Tdm * Tld) ** (1 / 4))
 
 
@@ -156,10 +159,13 @@ def run_full_bench(yaml_params: dict) -> None:
     # 3. query streams (RNGSEED = load end timestamp, spec 4.3.1)
     if not g.get("skip"):
         rngseed = get_load_end_timestamp(l["report_file"])
-        run(PY + ["ndstpu.queries.streamgen",
-                  "--output_dir", g["stream_output_path"],
-                  "--rngseed", rngseed,
-                  "--streams", str(num_streams)])
+        cmd = PY + ["ndstpu.queries.streamgen",
+                    "--output_dir", g["stream_output_path"],
+                    "--rngseed", rngseed,
+                    "--streams", str(num_streams)]
+        if g.get("template_dir"):
+            cmd += ["--template_dir", g["template_dir"]]
+        run(cmd)
 
     # 4. power test
     if not p.get("skip"):
@@ -198,7 +204,8 @@ def run_full_bench(yaml_params: dict) -> None:
         tdm[fs] = get_maintenance_time(m["report_base"], num_streams, fs)
 
     qps = len(__import__("ndstpu.queries.streamgen",
-                         fromlist=["list_templates"]).list_templates())
+                         fromlist=["list_templates"])
+              .list_templates(g.get("template_dir")))
     metric = get_perf_metric(sf, sq, qps, float(load_elapse), power_elapse,
                              ttt[1], ttt[2], tdm[1], tdm[2])
     metrics = {

@@ -1,0 +1,261 @@
+"""Minimal Avro Object Container File codec (write + read).
+
+Format parity with the reference's `--output_format avro` load test
+(nds/nds_transcode.py:121-144 via the spark-avro package): the subset of
+Avro 1.11 needed for NDS tables — records of nullable primitives with
+the standard logical types:
+
+  int32 -> ["null","int"]          date  -> ["null",{"int","date"}]
+  int64 -> ["null","long"]         string-> ["null","string"]
+  float64 -> ["null","double"]
+  decimal(p,s) -> ["null",{"bytes","decimal",precision,scale}]
+
+Self-contained (no external avro dependency is baked into this image);
+null codec; one block per row-group.  Values are framed row-by-row in
+Python — adequate for load-test format parity at bench scale factors;
+parquet remains the performance path (the reference's avro support is
+likewise a compatibility format, not its fast path).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from typing import List, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+_MAGIC = b"Obj\x01"
+_SYNC = bytes(range(16))  # deterministic sync marker
+_BLOCK_ROWS = 65536
+
+
+# -- varint helpers ----------------------------------------------------------
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _write_long(buf: io.BytesIO, n: int) -> None:
+    z = _zigzag(int(n)) & (2 ** 64 - 1)
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            buf.write(bytes((b | 0x80,)))
+        else:
+            buf.write(bytes((b,)))
+            return
+
+
+def _read_long(view: memoryview, pos: int) -> Tuple[int, int]:
+    shift = 0
+    acc = 0
+    while True:
+        b = view[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+def _write_bytes(buf: io.BytesIO, data: bytes) -> None:
+    _write_long(buf, len(data))
+    buf.write(data)
+
+
+# -- schema mapping ----------------------------------------------------------
+
+
+def _avro_field(name: str, typ: pa.DataType) -> dict:
+    if pa.types.is_int32(typ):
+        t: object = "int"
+    elif pa.types.is_int64(typ):
+        t = "long"
+    elif pa.types.is_float64(typ):
+        t = "double"
+    elif pa.types.is_string(typ) or pa.types.is_large_string(typ):
+        t = "string"
+    elif pa.types.is_date32(typ):
+        t = {"type": "int", "logicalType": "date"}
+    elif pa.types.is_decimal(typ):
+        t = {"type": "bytes", "logicalType": "decimal",
+             "precision": typ.precision, "scale": typ.scale}
+    else:
+        raise ValueError(f"avro: unsupported arrow type {typ}")
+    return {"name": name, "type": ["null", t]}
+
+
+def _schema_json(at: pa.Table, name: str) -> str:
+    return json.dumps({
+        "type": "record", "name": name,
+        "fields": [_avro_field(f.name, f.type) for f in at.schema]})
+
+
+# -- write -------------------------------------------------------------------
+
+
+def _decimal_bytes(unscaled: int) -> bytes:
+    """Two's-complement big-endian minimal representation."""
+    length = max(1, (unscaled.bit_length() + 8) // 8)
+    return int(unscaled).to_bytes(length, "big", signed=True)
+
+
+def write_table(at: pa.Table, path: str, name: str = "nds") -> None:
+    cols = []
+    for i, f in enumerate(at.schema):
+        col = at.column(i).combine_chunks()
+        cols.append((f.type, col))
+    with open(path, "wb") as f:
+        head = io.BytesIO()
+        head.write(_MAGIC)
+        meta = {"avro.schema": _schema_json(at, name).encode(),
+                "avro.codec": b"null"}
+        _write_long(head, len(meta))
+        for k, v in meta.items():
+            _write_bytes(head, k.encode())
+            _write_bytes(head, v)
+        _write_long(head, 0)
+        head.write(_SYNC)
+        f.write(head.getvalue())
+        n = at.num_rows
+        for start in range(0, max(n, 1), _BLOCK_ROWS):
+            count = min(_BLOCK_ROWS, n - start)
+            if count <= 0:
+                break
+            block = io.BytesIO()
+            _encode_block(block, cols, start, count)
+            framed = io.BytesIO()
+            _write_long(framed, count)
+            _write_long(framed, block.getbuffer().nbytes)
+            f.write(framed.getvalue())
+            f.write(block.getvalue())
+            f.write(_SYNC)
+
+
+def _encode_block(buf: io.BytesIO, cols, start: int, count: int) -> None:
+    # pre-extract python-friendly views per column
+    views = []
+    for typ, col in cols:
+        sl = col.slice(start, count)
+        mask = np.asarray(sl.is_null())
+        if pa.types.is_string(typ) or pa.types.is_large_string(typ):
+            vals = sl.to_pylist()
+        elif pa.types.is_decimal(typ):
+            scale = typ.scale
+            vals = [None if v is None else int(v.scaleb(scale))
+                    for v in sl.to_pylist()]
+        elif pa.types.is_date32(typ):
+            vals = sl.cast(pa.int32()).to_pylist()
+        else:
+            vals = sl.to_pylist()
+        views.append((typ, mask, vals))
+    for r in range(count):
+        for typ, mask, vals in views:
+            if mask[r]:
+                _write_long(buf, 0)  # union branch: null
+                continue
+            _write_long(buf, 1)      # union branch: value
+            v = vals[r]
+            if pa.types.is_string(typ) or pa.types.is_large_string(typ):
+                _write_bytes(buf, v.encode())
+            elif pa.types.is_float64(typ):
+                buf.write(struct.pack("<d", v))
+            elif pa.types.is_decimal(typ):
+                _write_bytes(buf, _decimal_bytes(v))
+            else:  # int / long / date
+                _write_long(buf, v)
+
+
+# -- read --------------------------------------------------------------------
+
+
+def read_table(path: str) -> pa.Table:
+    data = memoryview(open(path, "rb").read())
+    if bytes(data[:4]) != _MAGIC:
+        raise ValueError(f"{path}: not an avro container file")
+    pos = 4
+    meta = {}
+    while True:
+        n, pos = _read_long(data, pos)
+        if n == 0:
+            break
+        if n < 0:
+            # spec: negative map-block count is followed by the block's
+            # byte size (which we don't need when parsing sequentially)
+            _size, pos = _read_long(data, pos)
+        for _ in range(abs(n)):
+            klen, pos = _read_long(data, pos)
+            key = bytes(data[pos:pos + klen]).decode()
+            pos += klen
+            vlen, pos = _read_long(data, pos)
+            meta[key] = bytes(data[pos:pos + vlen])
+            pos += vlen
+    sync = bytes(data[pos:pos + 16])
+    pos += 16
+    schema = json.loads(meta["avro.schema"].decode())
+    if meta.get("avro.codec", b"null") not in (b"null", b""):
+        raise ValueError("avro: only the null codec is supported")
+    fields = schema["fields"]
+    out: List[List] = [[] for _ in fields]
+    while pos < len(data):
+        count, pos = _read_long(data, pos)
+        _size, pos = _read_long(data, pos)
+        for _ in range(count):
+            for fi, field in enumerate(fields):
+                branch, pos = _read_long(data, pos)
+                if branch == 0:
+                    out[fi].append(None)
+                    continue
+                t = field["type"][1]
+                base = t["type"] if isinstance(t, dict) else t
+                if base == "string":
+                    ln, pos = _read_long(data, pos)
+                    out[fi].append(bytes(data[pos:pos + ln]).decode())
+                    pos += ln
+                elif base == "double":
+                    out[fi].append(
+                        struct.unpack("<d", data[pos:pos + 8])[0])
+                    pos += 8
+                elif base == "bytes":  # decimal
+                    ln, pos = _read_long(data, pos)
+                    out[fi].append(int.from_bytes(
+                        data[pos:pos + ln], "big", signed=True))
+                    pos += ln
+                else:  # int / long / date
+                    v, pos = _read_long(data, pos)
+                    out[fi].append(v)
+        if bytes(data[pos:pos + 16]) != sync:
+            raise ValueError(f"{path}: bad block sync marker")
+        pos += 16
+    arrays = []
+    names = []
+    for field, vals in zip(fields, out):
+        t = field["type"][1]
+        names.append(field["name"])
+        if isinstance(t, dict) and t.get("logicalType") == "decimal":
+            typ = pa.decimal128(t["precision"], t["scale"])
+            import decimal as _dec
+            scale = t["scale"]
+            pyvals = [None if v is None else
+                      _dec.Decimal(v).scaleb(-scale) for v in vals]
+            arrays.append(pa.array(pyvals, type=typ))
+        elif isinstance(t, dict) and t.get("logicalType") == "date":
+            arrays.append(pa.array(vals, type=pa.date32()))
+        elif t == "int":
+            arrays.append(pa.array(vals, type=pa.int32()))
+        elif t == "long":
+            arrays.append(pa.array(vals, type=pa.int64()))
+        elif t == "double":
+            arrays.append(pa.array(vals, type=pa.float64()))
+        elif t == "string":
+            arrays.append(pa.array(vals, type=pa.string()))
+        else:
+            raise ValueError(f"avro: unsupported field type {t}")
+    return pa.Table.from_arrays(arrays, names=names)

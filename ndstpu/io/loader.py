@@ -109,7 +109,8 @@ def read_warehouse_table(warehouse: str, table: str,
         import pyarrow.parquet as pq
         parts = [pq.read_table(p, columns=columns) for p in singles]
         return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
-    for ext, fmt in (("orc", "orc"), ("csv", "csv"), ("json", "json")):
+    for ext, fmt in (("orc", "orc"), ("avro", "avro"), ("csv", "csv"),
+                     ("json", "json")):
         paths = sorted(glob.glob(os.path.join(root, f"{table}*.{ext}")))
         if paths:
             parts = []
@@ -117,6 +118,9 @@ def read_warehouse_table(warehouse: str, table: str,
                 if fmt == "orc":
                     import pyarrow.orc as paorc
                     parts.append(paorc.read_table(p))
+                elif fmt == "avro":
+                    from ndstpu.io import avroio
+                    parts.append(avroio.read_table(p))
                 elif fmt == "csv":
                     import pyarrow.csv as pacsv
                     parts.append(pacsv.read_csv(

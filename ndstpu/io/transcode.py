@@ -9,7 +9,7 @@ whose "Load Test Time" / "RNGSEED used:" lines follow the same parse contract
 (nds_transcode.py:196-220, consumed by nds_bench.py:60-90).  RNGSEED is the
 load end-timestamp `%m%d%H%M%S%f` truncated — TPC-DS spec 4.3.1 chaining.
 
-Output formats: parquet (primary TPU path), orc, csv, json, and `ndslake` —
+Output formats: parquet (primary TPU path), orc, avro, csv, json, and `ndslake` —
 this framework's ACID snapshot table format (Iceberg/Delta analog, see
 ndstpu.io.acid) used by the data-maintenance phase.
 """
@@ -76,6 +76,9 @@ def _write_single(at: pa.Table, out_dir: str, table: str, fmt: str,
         import pandas as pd  # noqa: F401
         at.to_pandas().to_json(path, orient="records", lines=True,
                                date_format="iso")
+    elif fmt == "avro":
+        from ndstpu.io import avroio
+        avroio.write_table(at, path, name=table)
     else:
         raise ValueError(f"unsupported format {fmt}")
 
@@ -162,7 +165,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--report_file", default="load_report.txt",
                    help="load test report path")
     p.add_argument("--output_format", default="parquet",
-                   choices=["parquet", "orc", "csv", "json", "ndslake"])
+                   choices=["parquet", "orc", "avro", "csv", "json",
+                            "ndslake"])
     p.add_argument("--output_mode", default="overwrite",
                    choices=["overwrite", "append", "ignore", "errorifexists"])
     p.add_argument("--tables", help="comma-separated subset of tables")

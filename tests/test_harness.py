@@ -214,6 +214,56 @@ def test_ensure_valid_column_names():
     assert out.column_names == ["ok_name", "column_1"]
 
 
+def test_full_bench_end_to_end(tmp_path, env):
+    """The nds_bench analog runs all five phases from YAML and emits the
+    composite metric (reference: nds/nds_bench.py:367-497)."""
+    root = tmp_path
+    # small template corpus keeps the 3-stream run fast
+    import shutil as _sh
+
+    from ndstpu.queries import streamgen
+    tpl_dir = root / "tpl"
+    tpl_dir.mkdir()
+    for t in ["query3.tpl", "query7.tpl", "query42.tpl", "query52.tpl",
+              "query96.tpl"]:
+        _sh.copy(streamgen.TEMPLATE_DIR / t, tpl_dir / t)
+    cfg = {
+        "data_gen": {"scale_factor": 0.002, "parallel": 2,
+                     "data_path": str(root / "raw"), "skip": False},
+        "load_test": {"warehouse_path": str(root / "wh"),
+                      "warehouse_format": "ndslake",
+                      "report_file": str(root / "load.txt"),
+                      "skip": False},
+        "generate_query_stream": {
+            "num_streams": 3, "template_dir": str(tpl_dir),
+            "stream_output_path": str(root / "streams"), "skip": False},
+        "power_test": {"engine": "cpu",
+                       "report_file": str(root / "power.csv"),
+                       "json_summary_folder": str(root / "json"),
+                       "output_prefix": "", "skip": False},
+        "throughput_test": {"report_base": str(root / "tt"),
+                            "skip": False},
+        "maintenance_test": {"report_base": str(root / "dm"),
+                             "skip": False},
+        "metrics": {"metrics_report": str(root / "metrics.csv")},
+    }
+    import yaml as _yaml
+    cfg_path = root / "bench.yml"
+    cfg_path.write_text(_yaml.safe_dump(cfg))
+    subprocess.run(["python", "-m", "ndstpu.harness.bench",
+                    str(cfg_path)], check=True, env=env,
+                   stdout=subprocess.DEVNULL, timeout=3000)
+    metrics = dict(line.split(",", 1) for line in
+                   (root / "metrics.csv").read_text().splitlines())
+    assert int(metrics["metric"]) > 0
+    assert float(metrics["Tpower(s)"]) >= 0
+    # all phase artifacts exist
+    assert (root / "power.csv").exists()
+    assert (root / "tt_1.csv").exists() and (root / "tt_2.csv").exists()
+    assert (root / "dm_1.csv").exists() and (root / "dm_2.csv").exists()
+    assert list((root / "json").glob("*-query3-*.json"))
+
+
 def test_metric_formula():
     m = bench_mod.get_perf_metric("100", 2, 99, 1000.0, 500.0, 300.0,
                                   310.0, 60.0, 65.0)

@@ -106,6 +106,64 @@ def test_string_dictionary_sorted(warehouse):
     assert list(d) == sorted(d)
 
 
+def test_avro_round_trip():
+    import decimal as pydec
+
+    from ndstpu.io import avroio
+    at = pa.table({
+        "i": pa.array([1, None, 3], type=pa.int32()),
+        "l": pa.array([2 ** 60, None, -5], type=pa.int64()),
+        "f": pa.array([1.5, None, float("nan")], type=pa.float64()),
+        "s": pa.array(["a", None, "日本"], type=pa.string()),
+        "d": pa.array([10957, None, 0], type=pa.int32()).cast(
+            pa.date32()),
+        "m": pa.array([pydec.Decimal("123.45"), None,
+                       pydec.Decimal("-0.01")],
+                      type=pa.decimal128(7, 2)),
+    })
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "t.avro")
+        avroio.write_table(at, p)
+        got = avroio.read_table(p)
+    assert got.schema.names == at.schema.names
+    for name in at.schema.names:
+        a = at.column(name).to_pylist()
+        b = got.column(name).to_pylist()
+        for va, vb in zip(a, b):
+            if isinstance(va, float) and va != va:
+                assert vb != vb
+            else:
+                assert va == vb, (name, va, vb)
+
+
+def test_avro_warehouse_round_trip(dataset, tmp_path):
+    """transcode --output_format avro and load the warehouse back."""
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    out = tmp_path / "wh_avro"
+    subprocess.run(
+        ["python", "-m", "ndstpu.io.transcode",
+         "--input_prefix", str(dataset), "--output_prefix", str(out),
+         "--report_file", str(out / "load.txt"),
+         "--output_format", "avro", "--tables", "item,store"],
+        check=True, env=env, stdout=subprocess.DEVNULL)
+    cat = loader.load_catalog(str(out), tables=["item", "store"])
+    t = cat.get("item")
+    assert t.num_rows > 0
+    assert "i_item_sk" in t.column_names
+    # agrees with the parquet path
+    cat2_dir = tmp_path / "wh_pq"
+    subprocess.run(
+        ["python", "-m", "ndstpu.io.transcode",
+         "--input_prefix", str(dataset), "--output_prefix", str(cat2_dir),
+         "--report_file", str(cat2_dir / "load.txt"),
+         "--tables", "item,store"],
+        check=True, env=env, stdout=subprocess.DEVNULL)
+    cat2 = loader.load_catalog(str(cat2_dir), tables=["item", "store"])
+    assert sorted(map(str, cat.get("item").to_rows())) == \
+        sorted(map(str, cat2.get("item").to_rows()))
+
+
 def test_acid_create_append_delete_rollback(tmp_path):
     at = pa.table({"k": pa.array([1, 2, 3, 4], pa.int32()),
                    "v": pa.array([10.0, 20.0, 30.0, 40.0])})

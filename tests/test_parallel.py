@@ -213,6 +213,15 @@ def test_session_spmd_backend(dist_catalog):
     a = cpu.sql(sql).to_rows()
     b = spmd.sql(sql).to_rows()
     assert sorted(map(str, a)) == sorted(map(str, b))
+    # repeat execution takes the cached-executor path (no re-trace) and
+    # stays correct
+    sql = ("select d_year, sum(ss_ext_sales_price) as s from store_sales, "
+           "date_dim where ss_sold_date_sk = d_date_sk group by d_year "
+           "order by d_year")
+    first = spmd.sql(sql).to_rows()
+    assert sql in " ".join(k or "" for k in spmd._spmd_cache)
+    again = spmd.sql(sql).to_rows()
+    assert first == again == cpu.sql(sql).to_rows()
     # not distributable (no sharded-size table) -> single-chip fallback
     spmd._spmd_used = False
     sql = "select s_store_sk, s_store_id from store order by s_store_sk"
