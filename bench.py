@@ -86,11 +86,22 @@ def main() -> None:
     tpu_sess = Session(catalog, backend="tpu")
 
     cpu_s = _power_run(cpu_sess, queries)
-    # run1 = discovery, run2 = trace+compile(+cache) and replay, run3 =
-    # pure compiled replay — the steady-state power-run number
+    # persisted size-plan records skip the per-query eager discovery
+    # pass; with the XLA cache warm, run1 is then already compiled replay
+    rec_path = os.path.join(CACHE, f"plans_sf{SF}.pkl")
+    try:
+        tpu_sess.preload_compiled(rec_path)
+    except Exception:
+        pass  # stale/corrupt records: discovery path still works
+    # run1 = discovery (or preloaded replay), run2 = trace+compile(+cache)
+    # and replay, run3 = pure compiled replay — the steady-state number
     n_runs = int(os.environ.get("NDSTPU_BENCH_RUNS", "3"))
     runs = [_power_run(tpu_sess, queries) for _ in range(n_runs)]
     tpu_s = min(runs)
+    try:
+        tpu_sess.save_compiled(rec_path)
+    except Exception:
+        pass
 
     print(json.dumps({
         "metric": f"nds_power_run_elapsed_sf{SF}_"

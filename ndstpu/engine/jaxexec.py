@@ -1896,6 +1896,9 @@ class _CompiledPlan:
     table_cols: Dict[str, Optional[List[str]]] = None
     fn: object = None                    # jitted replay function
     out_meta: List[tuple] = None         # (name, ctype, dictionary)
+    # loaded from disk and not yet validated by a successful replay —
+    # the first execution self-heals (rediscovers) on any failure
+    preloaded: bool = False
 
 
 def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
@@ -1931,11 +1934,31 @@ class CompilingExecutor(JaxExecutor):
             cp = None
         if cp is None:
             return self._discover(p, key, versions)
-        if not cp.compilable or cp.fn is None:
+        if not cp.compilable:
             return self.execute_to_host(cp.plan)
+        if cp.fn is None:
+            # size-plan record preloaded from disk (see
+            # save/load_compile_records): build the jitted replay now
+            try:
+                cp.fn = self._build_jit(cp)
+            except Exception:
+                self._compiled.pop(key, None)
+                return self._discover(p, key, versions)
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
-        (out, alive), ok = cp.fn(args)
+        if cp.preloaded:
+            # first execution of a disk-loaded record: any failure means
+            # the record drifted (code or data changed) — rediscover.
+            # Only this first call is guarded; later failures are real
+            # device errors and must propagate.
+            try:
+                (out, alive), ok = cp.fn(args)
+            except Exception:
+                self._compiled.pop(key, None)
+                return self._discover(p, key, versions)
+            cp.preloaded = False
+        else:
+            (out, alive), ok = cp.fn(args)
         # ONE batched device->host fetch: per-array np.asarray costs a
         # tunnel round-trip each (~10-30ms on the axon TPU link)
         (out, alive_np), ok = jax.device_get(((out, alive), ok))
@@ -1974,6 +1997,69 @@ class CompilingExecutor(JaxExecutor):
                 cp.compilable = False
         self._compiled[key] = cp
         return host
+
+    def _table_fingerprint(self, name: str) -> tuple:
+        """Cheap content identity for a catalog table: row count + a
+        prefix checksum over integer-backed columns.  Guards persisted
+        size-plan records against a *different dataset* at the same
+        paths — per-process version counters cannot (they restart at 1)."""
+        t = self.catalog.get(name)
+        chk = 0
+        for cname in t.column_names[:3]:
+            col = t.column(cname)
+            if col.data.dtype.kind in "iu":
+                chk ^= int(np.asarray(col.data[:4096], dtype=np.int64)
+                           .sum()) & (2 ** 61 - 1)
+        return (name, t.num_rows, chk)
+
+    def save_compile_records(self, path: str) -> int:
+        """Persist discovery size-plan records (NOT compiled code — XLA
+        has its own persistent cache) so a fresh process can skip the
+        eager discovery pass per query.  Keys are stored as bare SQL
+        text (the in-memory views-epoch prefix is process-local).
+        Returns the record count."""
+        import pickle
+        data = {}
+        for key, cp in self._compiled.items():
+            if cp.compilable and cp.record is not None:
+                sql = key.split("|", 1)[1] if "|" in key else key
+                fps = tuple(self._table_fingerprint(t)
+                            for t in sorted(cp.table_cols or ()))
+                data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta)
+        with open(path, "wb") as f:
+            pickle.dump(data, f)
+        return len(data)
+
+    def load_compile_records(self, path: str, plan_for_key,
+                             key_prefix: str = "0") -> int:
+        """Preload size-plan records saved by save_compile_records.
+        `plan_for_key(sql)` must return the optimized plan for the SQL
+        text (or None to skip).  Records whose table fingerprints no
+        longer match the catalog are dropped; drifted records self-heal
+        at first execution (the replay guard rediscovers).  Returns the
+        count loaded."""
+        import pickle
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        versions_now = tuple(sorted(
+            getattr(self.catalog, "versions", {}).items()))
+        n = 0
+        for sql, (record, fps, table_cols, out_meta) in data.items():
+            try:
+                ok = all(self._table_fingerprint(fp[0]) == fp
+                         for fp in fps)
+            except KeyError:
+                continue
+            if not ok:
+                continue
+            plan = plan_for_key(sql)
+            if plan is None:
+                continue
+            self._compiled[f"{key_prefix}|{sql}"] = _CompiledPlan(
+                plan, True, record, versions_now, table_cols, None,
+                out_meta, preloaded=True)
+            n += 1
+        return n
 
     def _table_args(self, name: str, cols: Optional[List[str]] = None):
         dt = self._table_device(name)

@@ -113,7 +113,12 @@ class Session:
                 self._spmd_dev_cache = {}
             ck = f"{self._views_epoch}|{key}" if key is not None else None
             ent = cache.get(ck) if ck else None
-            if ent is not None and ent[0] == versions:
+            if ent is not None and ent[0] != versions:
+                # data changed: drop the stale executor (its pinned
+                # device args go with it) and rebuild below
+                del cache[ck]
+                ent = None
+            if ent is not None:
                 self._spmd_used = True
                 return ent[1].execute_again()
             try:
@@ -154,6 +159,27 @@ class Session:
         if exe is None:
             return None
         return exe._compiled.get(f"{self._views_epoch}|{text}")
+
+    def save_compiled(self, path: str) -> int:
+        """Persist whole-query size-plan records for the jax backend."""
+        return self._jax_executor().save_compile_records(path)
+
+    def preload_compiled(self, path: str) -> int:
+        """Preload size-plan records: later sql() calls skip discovery
+        and go straight to the jitted replay (warm XLA cache makes the
+        first execution ~compile-free too)."""
+        def plan_for_sql(sql):
+            try:
+                plan, _cols = self.plan(sql)
+            except Exception:
+                return None
+            return plan
+
+        import os
+        if not os.path.exists(path):
+            return 0
+        return self._jax_executor().load_compile_records(
+            path, plan_for_sql, key_prefix=str(self._views_epoch))
 
     def _jax_executor(self):
         """One executor per session: keeps uploaded tables cached in HBM

@@ -149,6 +149,12 @@ def run_query_stream(args) -> None:
     catalog = loader.load_catalog(args.input_prefix,
                                   use_decimal=not args.floats)
     sess = Session(catalog, backend=args.engine)
+    if args.compile_records and args.engine in ("tpu", "tpu-spmd"):
+        try:
+            n = sess.preload_compiled(args.compile_records)
+            print(f"preloaded {n} compile records")
+        except Exception as e:  # stale records must never kill the run
+            print(f"WARNING: compile records not loaded: {e}")
     execution_times.append(
         (app_id, "CreateTempView all tables",
          int((time.time() - load_start) * 1000)))
@@ -185,6 +191,12 @@ def run_query_stream(args) -> None:
     execution_times.append((app_id, "Power End Time", power_end))
     execution_times.append((app_id, "Power Test Time", power_elapse))
     execution_times.append((app_id, "Total Time", total_elapse))
+
+    if args.compile_records and args.engine in ("tpu", "tpu-spmd"):
+        try:
+            sess.save_compiled(args.compile_records)
+        except Exception as e:
+            print(f"WARNING: compile records not saved: {e}")
 
     header = ["application_id", "query", "time/milliseconds"]
     with open(args.time_log, "w", encoding="UTF8", newline="") as f:
@@ -229,6 +241,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "query1,query3_part1")
     p.add_argument("--extra_time_log",
                    help="secondary location for the CSV time log")
+    p.add_argument("--compile_records",
+                   help="path for persisted whole-query size-plan "
+                        "records (skip per-query discovery on repeat "
+                        "power runs; tpu engines only)")
     p.add_argument("--floats", action="store_true",
                    help="double mode (no decimals)")
     return p

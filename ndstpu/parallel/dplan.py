@@ -217,6 +217,13 @@ class DistributedPlanExecutor:
 
     # -- spine preparation ---------------------------------------------------
 
+    def _evict_stale(self, table: str, col: str) -> None:
+        """Drop superseded-version device copies of (table, col) so
+        maintenance rounds don't accumulate dead fact copies in HBM."""
+        for k in [k for k in self.dev_cache
+                  if k[0] == table and k[1] == col]:
+            del self.dev_cache[k]
+
     def _resolve_all(self, p: lp.Plan) -> None:
         for node in p.walk():
             if isinstance(node, lp.Scan) and node.predicate is not None:
@@ -340,6 +347,7 @@ class DistributedPlanExecutor:
             ckey = (self.fact.table, name, version, padded)
             ent = self.dev_cache.get(ckey)
             if ent is None:
+                self._evict_stale(self.fact.table, name)
                 data = np.zeros(padded, dtype=c.data.dtype)
                 data[:n] = c.data
                 valid = np.zeros(padded, dtype=bool)
@@ -351,6 +359,7 @@ class DistributedPlanExecutor:
         akey = (self.fact.table, "__alive__", version, padded)
         al = self.dev_cache.get(akey)
         if al is None:
+            self._evict_stale(self.fact.table, "__alive__")
             alive = np.zeros(padded, dtype=bool)
             alive[:n] = True
             al = jax.device_put(alive, row_sh)

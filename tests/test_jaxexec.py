@@ -318,6 +318,29 @@ def test_exists_under_or_mark_join(cpu_sess, tpu_sess):
           "c.c_customer_sk)")
 
 
+def test_compile_record_persistence(catalog, cpu_sess, tmp_path):
+    """Saved size-plan records let a fresh session skip discovery and go
+    straight to jitted replay, with identical results."""
+    from ndstpu.engine.session import Session
+    sql = ("select i_category, count(*) as n, sum(ss_net_paid) as s "
+           "from store_sales join item on ss_item_sk = i_item_sk "
+           "group by i_category order by i_category")
+    s1 = Session(catalog, backend="tpu")
+    want = s1.sql(sql).to_rows()
+    path = str(tmp_path / "plans.pkl")
+    assert s1.save_compiled(path) >= 1
+    s2 = Session(catalog, backend="tpu")
+    assert s2.preload_compiled(path) >= 1
+    got = s2.sql(sql).to_rows()
+    assert sorted(map(str, got)) == sorted(map(str, want))
+    # the preloaded entry went straight to replay: the executor never ran
+    # discovery for this SQL (its compiled record has a jitted fn now)
+    cp = s2.compiled_plan(sql)
+    assert cp is not None and cp.compilable and cp.fn is not None
+    assert sorted(map(str, cpu_sess.sql(sql).to_rows())) == \
+        sorted(map(str, got))
+
+
 def test_corpus_compile_coverage(catalog):
     """Most corpus templates must compile to single XLA programs (no
     numpy fallback) — fallbacks are allowed but should be the minority."""
