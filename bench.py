@@ -48,12 +48,19 @@ def _ensure_warehouse() -> str:
     return wh
 
 
-def _power_run(sess, queries) -> float:
+def _power_run(sess, queries, failures=None) -> float:
     t0 = time.time()
     for name, sql in queries:
-        out = sess.sql(sql)
-        # materialize like collect() (nds_power.py:124-134)
-        out.to_rows()
+        try:
+            out = sess.sql(sql)
+            # materialize like collect() (nds_power.py:124-134)
+            out.to_rows()
+        except Exception as e:  # keep the run alive (transient compile
+            # infra errors must not zero a 99-query benchmark)
+            print(f"BENCH-ERROR {name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            if failures is not None:
+                failures.append(name)
     return time.time() - t0
 
 
@@ -85,7 +92,11 @@ def main() -> None:
     cpu_sess = Session(catalog, backend="cpu")
     tpu_sess = Session(catalog, backend="tpu")
 
-    cpu_s = _power_run(cpu_sess, queries)
+    cpu_fail: list = []
+    cpu_s = _power_run(cpu_sess, queries, cpu_fail)
+    if cpu_fail:
+        print(f"BENCH-WARNING: {len(cpu_fail)} baseline queries failed: "
+              f"{cpu_fail}", file=sys.stderr)
     # persisted size-plan records skip the per-query eager discovery
     # pass; with the XLA cache warm, run1 is then already compiled replay
     rec_path = os.path.join(CACHE, f"plans_sf{SF}.pkl")
@@ -96,12 +107,22 @@ def main() -> None:
     # run1 = discovery (or preloaded replay), run2 = trace+compile(+cache)
     # and replay, run3 = pure compiled replay — the steady-state number
     n_runs = int(os.environ.get("NDSTPU_BENCH_RUNS", "3"))
-    runs = [_power_run(tpu_sess, queries) for _ in range(n_runs)]
-    tpu_s = min(runs)
-    try:
-        tpu_sess.save_compiled(rec_path)
-    except Exception:
-        pass
+    runs, fail_lists = [], []
+    for _ in range(n_runs):
+        failures: list = []
+        runs.append(_power_run(tpu_sess, queries, failures))
+        fail_lists.append(failures)
+        try:  # persist incrementally: a crash must not lose the records
+            tpu_sess.save_compiled(rec_path)
+        except Exception:
+            pass
+    # a run where queries errored did less work — never report it
+    clean = [t for t, f in zip(runs, fail_lists) if not f]
+    tpu_s = min(clean) if clean else min(runs)
+    for i, f in enumerate(fail_lists):
+        if f:
+            print(f"BENCH-WARNING: run {i + 1}: {len(f)} queries failed: "
+                  f"{f}", file=sys.stderr)
 
     print(json.dumps({
         "metric": f"nds_power_run_elapsed_sf{SF}_"

@@ -1899,6 +1899,9 @@ class _CompiledPlan:
     # loaded from disk and not yet validated by a successful replay —
     # the first execution self-heals (rediscovers) on any failure
     preloaded: bool = False
+    # fn has executed successfully at least once: later backend errors
+    # are real device failures and propagate instead of falling back
+    fn_validated: bool = False
 
 
 def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
@@ -1944,27 +1947,49 @@ class CompilingExecutor(JaxExecutor):
             except Exception:
                 self._compiled.pop(key, None)
                 return self._discover(p, key, versions)
-        args = {t: self._accel_args(t, cols)
-                for t, cols in cp.table_cols.items()}
         if cp.preloaded:
-            # first execution of a disk-loaded record: any failure means
-            # the record drifted (code or data changed) — rediscover.
-            # Only this first call is guarded; later failures are real
-            # device errors and must propagate.
+            # first execution of a disk-loaded record: ANY failure —
+            # arg build, compile, execution, or result assembly against
+            # stale out_meta — means the record drifted; rediscover
             try:
-                (out, alive), ok = cp.fn(args)
+                result = self._replay(cp)
             except Exception:
+                result = None
+            if result is None:
                 self._compiled.pop(key, None)
                 return self._discover(p, key, versions)
             cp.preloaded = False
-        else:
-            (out, alive), ok = cp.fn(args)
+            cp.fn_validated = True
+            return result
+        try:
+            result = self._replay(cp)
+        except jax.errors.JaxRuntimeError as e:
+            if cp.fn_validated:
+                raise  # a real device failure, not a compile rejection
+            # whole-program compile rejected/crashed by the backend
+            # (e.g. a remote-compile helper failure): permanently run
+            # this query on the eager per-op path — slower, correct
+            print(f"WARNING: whole-query compile failed, running "
+                  f"eagerly: {e}")
+            cp.compilable = False
+            cp.fn = None
+            return self.execute_to_host(cp.plan)
+        if result is None:  # size-class guard failed: data changed
+            self._compiled.pop(key, None)
+            return self._discover(p, key, versions)
+        cp.fn_validated = True
+        return result
+
+    def _replay(self, cp: _CompiledPlan) -> Optional[Table]:
+        """Run the jitted whole-query program; None = size guard failed."""
+        args = {t: self._accel_args(t, cols)
+                for t, cols in cp.table_cols.items()}
+        (out, alive), ok = cp.fn(args)
         # ONE batched device->host fetch: per-array np.asarray costs a
         # tunnel round-trip each (~10-30ms on the axon TPU link)
         (out, alive_np), ok = jax.device_get(((out, alive), ok))
         if not bool(ok):
-            self._compiled.pop(key, None)
-            return self._discover(p, key, versions)
+            return None
         cols = {}
         for name, ctype, dictionary in cp.out_meta:
             data, valid = out[name]
@@ -2023,8 +2048,11 @@ class CompilingExecutor(JaxExecutor):
         for key, cp in self._compiled.items():
             if cp.compilable and cp.record is not None:
                 sql = key.split("|", 1)[1] if "|" in key else key
-                fps = tuple(self._table_fingerprint(t)
-                            for t in sorted(cp.table_cols or ()))
+                try:
+                    fps = tuple(self._table_fingerprint(t)
+                                for t in sorted(cp.table_cols or ()))
+                except KeyError:
+                    continue  # references a since-dropped table
                 data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta)
         with open(path, "wb") as f:
             pickle.dump(data, f)
