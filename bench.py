@@ -123,14 +123,18 @@ def main() -> None:
         if f:
             print(f"BENCH-WARNING: run {i + 1}: {len(f)} queries failed: "
                   f"{f}", file=sys.stderr)
+    failed_queries = sorted(set().union(*fail_lists)) if not clean else []
 
-    print(json.dumps({
+    result = {
         "metric": f"nds_power_run_elapsed_sf{SF}_"
                   f"{len(queries)}q",
         "value": round(tpu_s, 4),
         "unit": "s",
         "vs_baseline": round(cpu_s / tpu_s, 4) if tpu_s > 0 else 0.0,
-    }))
+    }
+    if failed_queries:  # every run had failures: mark the number tainted
+        result["failed_queries"] = failed_queries
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

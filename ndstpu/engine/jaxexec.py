@@ -760,6 +760,16 @@ class JEval:
 # ---------------------------------------------------------------------------
 
 
+def _sum_input(data: jnp.ndarray, valid: jnp.ndarray, kind: str):
+    """Summation input under the TPU precision rule: decimal/int sums
+    stay exact int64 (s64 is exactly emulated on TPU via s32 pairs);
+    float sums are float64 (which TPU hardware computes at f32
+    precision — acceptable only for genuinely-float data)."""
+    if kind in ("decimal", "int32", "int64"):
+        return jnp.where(valid, data.astype(jnp.int64), jnp.int64(0))
+    return jnp.where(valid, data.astype(jnp.float64), 0.0)
+
+
 def _key_i64(c: DCol, alive: jnp.ndarray,
              peer: Optional[DCol] = None) -> jnp.ndarray:
     """Column -> int64 key with NULL/dead sentinels (grouping/join space).
@@ -775,20 +785,20 @@ def _key_i64(c: DCol, alive: jnp.ndarray,
         else:
             data = c.data.astype(jnp.int64)
     elif c.ctype.kind == "float64":
-        # order-preserving float64 -> int64: flip sign-magnitude encoding
-        # into two's complement.  The full int64 range is used (consumers
-        # only sort/compare keys); only the EXACT sentinel codes are
-        # nudged one ulp so no real value collides with NULL/dead/join
-        # markers: 2.0 merges with nextafter(2.0,0), -0.0 folds onto +0.0
-        # (SQL equality), plus two denormal-adjacent pairs — nothing a
-        # decimal-derived benchmark dataset can distinguish
-        bits = jax.lax.bitcast_convert_type(
-            c.data.astype(jnp.float64), jnp.int64)
-        mono = jnp.where(bits < 0, jnp.int64(-(2 ** 63)) - bits - 1, bits)
-        mono = jnp.where(mono == _NULL_KEY, _NULL_KEY + 1, mono)
-        mono = jnp.where(mono == _DEAD_KEY, _DEAD_KEY - 1, mono)
-        mono = jnp.where(mono == -1, jnp.int64(0), mono)
-        data = jnp.where(mono == -2, jnp.int64(-3), mono)
+        # float64 keys STAY float64: consumers only sort and compare, and
+        # the TPU X64-rewrite pass has no lowering for f64<->s64
+        # bitcast-convert (a bit-pattern encoding crashes the TPU
+        # compiler outright).  IEEE gives SQL semantics for free
+        # (-0.0 == 0.0); NaNs fold to +inf so they group/join as one
+        # value; the sentinel magnitudes (2^62) are exactly representable
+        # and far outside any decimal-derived data domain.
+        data = c.data.astype(jnp.float64)
+        # NaNs fold to DBL_MAX (one NaN group, +inf stays distinct;
+        # only a literal DBL_MAX in the data could collide)
+        data = jnp.where(jnp.isnan(data),
+                         jnp.finfo(jnp.float64).max, data)
+        data = jnp.where(c.valid, data, jnp.float64(_NULL_KEY))
+        return jnp.where(alive, data, jnp.float64(_DEAD_KEY))
     else:
         data = c.data.astype(jnp.int64)
     data = jnp.where(c.valid, data, _NULL_KEY)
@@ -1286,22 +1296,21 @@ class JaxExecutor:
         got = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                   num_segments=ngseg) > 0
         if func == "sum":
-            if c.ctype.kind in ("decimal", "int32", "int64"):
-                vals = jnp.where(valid, c.data.astype(jnp.int64), 0)
-                sums = jax.ops.segment_sum(vals, gid, num_segments=ngseg)
-                if c.ctype.kind == "decimal":
-                    return DCol(sums, got, decimal(38, c.ctype.scale))
+            sums = jax.ops.segment_sum(
+                _sum_input(c.data, valid, c.ctype.kind), gid,
+                num_segments=ngseg)
+            if c.ctype.kind == "decimal":
+                return DCol(sums, got, decimal(38, c.ctype.scale))
+            if c.ctype.kind in ("int32", "int64"):
                 return DCol(sums, got, INT64)
-            vals = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
-            sums = jax.ops.segment_sum(vals, gid, num_segments=ngseg)
             return DCol(sums, got, FLOAT64)
         if func == "avg":
-            vals = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
-            sums = jax.ops.segment_sum(vals, gid, num_segments=ngseg)
             cnts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                        num_segments=ngseg)
-            denom = jnp.maximum(cnts, 1)
-            data = sums / denom
+            sums = jax.ops.segment_sum(
+                _sum_input(c.data, valid, c.ctype.kind), gid,
+                num_segments=ngseg)
+            data = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
             if c.ctype.kind == "decimal":
                 data = data / (10 ** c.ctype.scale)
             return DCol(data, cnts > 0, FLOAT64)
@@ -1450,19 +1459,19 @@ class JaxExecutor:
                 valid.astype(jnp.int64), gid, num_segments=cap)[gid],
                 jnp.ones(cap, bool), INT64)
         if w.func == "sum":
-            if arg.ctype.kind in ("decimal", "int32", "int64"):
-                vals = jnp.where(valid, arg.data.astype(jnp.int64), 0)
-                tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
-                ct = decimal(38, arg.ctype.scale) \
-                    if arg.ctype.kind == "decimal" else INT64
-                return DCol(tot[gid], got, ct)
-            vals = jnp.where(valid, arg.data.astype(jnp.float64), 0.0)
-            tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
+            tot = jax.ops.segment_sum(
+                _sum_input(arg.data, valid, arg.ctype.kind), gid,
+                num_segments=cap)
+            if arg.ctype.kind == "decimal":
+                return DCol(tot[gid], got, decimal(38, arg.ctype.scale))
+            if arg.ctype.kind in ("int32", "int64"):
+                return DCol(tot[gid], got, INT64)
             return DCol(tot[gid], got, FLOAT64)
         if w.func == "avg":
-            vals = jnp.where(valid, arg.data.astype(jnp.float64), 0.0)
-            tot = jax.ops.segment_sum(vals, gid, num_segments=cap)
-            mean = tot / jnp.maximum(cnts, 1)
+            tot = jax.ops.segment_sum(
+                _sum_input(arg.data, valid, arg.ctype.kind), gid,
+                num_segments=cap)
+            mean = tot.astype(jnp.float64) / jnp.maximum(cnts, 1)
             if arg.ctype.kind == "decimal":
                 mean = mean / (10 ** arg.ctype.scale)
             return DCol(mean[gid], got, FLOAT64)
@@ -1525,21 +1534,20 @@ class JaxExecutor:
         got = (rcnt > 0)[inv]
         if w.func == "count":
             return DCol(rcnt[inv], jnp.ones(cap, bool), INT64)
-        if w.func == "sum" and arg.ctype.kind in ("decimal", "int32",
-                                                  "int64"):
-            run = seg_cumsum(
-                jnp.where(valid_s, data_s.astype(jnp.int64), 0))[run_end]
-            ct = decimal(38, arg.ctype.scale) \
-                if arg.ctype.kind == "decimal" else INT64
-            return DCol(run[inv], got, ct)
         if w.func in ("sum", "avg"):
-            x = jnp.where(valid_s, data_s.astype(jnp.float64), 0.0)
+            run = seg_cumsum(
+                _sum_input(data_s, valid_s, arg.ctype.kind))[run_end]
+            if w.func == "sum":
+                if arg.ctype.kind == "decimal":
+                    return DCol(run[inv], got,
+                                decimal(38, arg.ctype.scale))
+                if arg.ctype.kind in ("int32", "int64"):
+                    return DCol(run[inv], got, INT64)
+                return DCol(run[inv], got, FLOAT64)
+            mean = run.astype(jnp.float64)
             if arg.ctype.kind == "decimal":
-                x = x / (10 ** arg.ctype.scale)
-            run = seg_cumsum(x)[run_end]
-            if w.func == "avg":
-                run = run / jnp.maximum(rcnt, 1)
-            return DCol(run[inv], got, FLOAT64)
+                mean = mean / (10 ** arg.ctype.scale)
+            return DCol((mean / jnp.maximum(rcnt, 1))[inv], got, FLOAT64)
         if w.func in ("min", "max"):
             is_min = w.func == "min"
             opfn = jnp.minimum if is_min else jnp.maximum
@@ -1963,17 +1971,20 @@ class CompilingExecutor(JaxExecutor):
             return result
         try:
             result = self._replay(cp)
-        except jax.errors.JaxRuntimeError as e:
+        except jax.errors.JaxRuntimeError as first_err:
             if cp.fn_validated:
                 raise  # a real device failure, not a compile rejection
-            # whole-program compile rejected/crashed by the backend
-            # (e.g. a remote-compile helper failure): permanently run
-            # this query on the eager per-op path — slower, correct
-            print(f"WARNING: whole-query compile failed, running "
-                  f"eagerly: {e}")
-            cp.compilable = False
-            cp.fn = None
-            return self.execute_to_host(cp.plan)
+            # could be a compile rejection OR a transient device fault
+            # (preemption/OOM): retry once before permanently demoting
+            # this query to the eager per-op path — slower, correct
+            try:
+                result = self._replay(cp)
+            except jax.errors.JaxRuntimeError:
+                print(f"WARNING: whole-query compile failed twice, "
+                      f"running eagerly: {first_err}")
+                cp.compilable = False
+                cp.fn = None
+                return self.execute_to_host(cp.plan)
         if result is None:  # size-class guard failed: data changed
             self._compiled.pop(key, None)
             return self._discover(p, key, versions)

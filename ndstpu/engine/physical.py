@@ -463,9 +463,11 @@ class Executor:
             g = gids[vidx]
             v = c.data[vidx]
             if c.ctype.kind == "float64":
-                # bit-pattern key, but -0.0 folds onto +0.0 (SQL equality;
-                # matches the device path's _key_i64)
-                key = np.where(v == 0, np.int64(0), v.view(np.int64))
+                # bit-pattern key with -0.0 folded onto +0.0 and NaNs
+                # canonicalized (SQL equality; matches the device path's
+                # _key_i64 float handling)
+                vc = np.where(np.isnan(v), np.finfo(np.float64).max, v)
+                key = np.where(vc == 0, np.int64(0), vc.view(np.int64))
             else:
                 key = v.astype(np.int64)
             comp = np.stack([g, key], axis=1) if len(vidx) else \

@@ -52,6 +52,7 @@ from ndstpu.engine.jaxexec import (
     _DEAD_KEY,
     _group_ids,
     _key_i64,
+    _sum_input,
 )
 from ndstpu.parallel.mesh import SHARD_AXIS
 
@@ -586,14 +587,9 @@ class DistributedPlanExecutor:
         if a.func == "count":
             return [cnt], meta
         if a.func in ("sum", "avg"):
-            if c.ctype.kind in ("decimal", "int32", "int64"):
-                s = jax.ops.segment_sum(
-                    jnp.where(valid, c.data.astype(jnp.int64), 0), gid,
-                    num_segments=cap)
-            else:
-                s = jax.ops.segment_sum(
-                    jnp.where(valid, c.data.astype(jnp.float64), 0.0),
-                    gid, num_segments=cap)
+            s = jax.ops.segment_sum(
+                _sum_input(c.data, valid, c.ctype.kind), gid,
+                num_segments=cap)
             return [s, cnt], meta
         if a.func in ("min", "max"):
             if c.ctype.kind == "float64":
