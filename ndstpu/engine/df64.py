@@ -1,0 +1,121 @@
+"""Compensated ("double-single") float accumulation for TPU.
+
+TPU hardware has no f64 ALU: under the x64 rewrite, ``jnp.float64``
+arithmetic lands at f32 precision (verified on chip — docs/STATUS.md).
+Money math in this engine is exact scaled-int64 and unaffected; the
+exposure is genuinely-float aggregation (``--floats`` mode, stddev
+moments), where a naive f32 segment-sum accumulates drift that grows
+with the row count and can breach the validator's 1e-5 epsilon
+(nds/nds_validate.py:48-114 semantics) at large scale factors.
+
+This module accumulates in an unevaluated pair of f32s (hi + lo, ~48-bit
+effective mantissa) using error-free transforms:
+
+* Knuth TwoSum — exact error of one f32 addition (no branch, VPU-friendly)
+* a pair-add (Dekker add2) used as the combiner of a segmented
+  ``lax.associative_scan`` — a log-depth, fully parallel reduction tree
+  whose every node re-captures the rounding error, so the final hi+lo
+  carries the sum to ~2^-48 relative instead of f32's 2^-24 drift.
+
+The segmented-scan trick: carry = (segment id, hi, lo); the combiner
+restarts the accumulator when segment ids differ.  Flag/segment scans
+are associative, so XLA is free to tree-schedule them.  Inputs must be
+pre-sorted by segment id — the aggregation paths already sort to build
+group ids, so this is free at the call sites.
+
+On CPU (tests / numpy mesh) every op here is IEEE f32 too, so behavior
+is bit-identical across backends by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # keep f64 carriers real on host
+
+import jax.numpy as jnp  # noqa: E402
+from jax import lax
+
+
+def two_sum(a: jnp.ndarray, b: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Error-free f32 addition: s + e == a + b exactly (Knuth)."""
+    s = a + b
+    bb = s - a
+    e = (a - (s - bb)) + (b - bb)
+    return s, e
+
+
+def ds_add(ah, al, bh, bl) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Add two double-single numbers, renormalized."""
+    s, e = two_sum(ah, bh)
+    e = e + (al + bl)
+    hi = s + e
+    lo = e - (hi - s)
+    return hi, lo
+
+
+def ds_from_f64(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Split a float64 array into a (hi, lo) f32 pair.
+
+    On the host (real f64) this is an exact split; on TPU the value is
+    already f32-precision so lo comes out ~0 — harmless either way."""
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(x.dtype)).astype(jnp.float32)
+    return hi, lo
+
+
+def ds_to_f64(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    """Recombine; exact on host f64, 2^-24 relative on TPU (the final
+    single rounding — the accumulated drift is what the pair removed)."""
+    return hi.astype(jnp.float64) + lo.astype(jnp.float64)
+
+
+def segment_sum_ds(x: jnp.ndarray, gid_sorted: jnp.ndarray,
+                   num_segments: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compensated per-segment sum over rows pre-sorted by segment id.
+
+    ``x`` float64 values in sorted-segment order (invalid rows must be
+    zeroed), ``gid_sorted`` the matching non-decreasing segment ids.
+    Returns per-segment (hi, lo) f32 pairs; combine with
+    :func:`ds_to_f64` (host-side for full effect).
+    """
+    n = x.shape[0]
+    if n == 0:
+        z = jnp.zeros(num_segments, jnp.float32)
+        return z, z
+    hi, lo = ds_from_f64(x)
+
+    def combine(a, b):
+        ga, ha, la = a
+        gb, hb, lb = b
+        same = ga == gb
+        nh, nl = ds_add(jnp.where(same, ha, 0.0),
+                        jnp.where(same, la, 0.0), hb, lb)
+        return gb, nh, nl
+
+    g, sh, sl = lax.associative_scan(
+        combine, (gid_sorted.astype(jnp.int64), hi, lo))
+    # segment totals sit at each segment's last row; scatter-add so the
+    # non-last rows (adding 0.0) can never clobber a total the way a
+    # duplicate-index scatter-set could
+    last = jnp.ones(n, bool).at[:-1].set(g[:-1] != g[1:])
+    seg = jnp.clip(g, 0, num_segments - 1)
+    out_hi = jnp.zeros(num_segments, jnp.float32).at[seg].add(
+        jnp.where(last, sh, 0.0))
+    out_lo = jnp.zeros(num_segments, jnp.float32).at[seg].add(
+        jnp.where(last, sl, 0.0))
+    return out_hi, out_lo
+
+
+def segment_sum_compensated(x: jnp.ndarray, gid: jnp.ndarray,
+                            num_segments: int,
+                            order: jnp.ndarray) -> jnp.ndarray:
+    """Drop-in for ``jax.ops.segment_sum`` on float64 data with an
+    available sort order (``gid[order]`` non-decreasing).  Returns f64
+    per-segment sums accumulated at ~2^-48 instead of f32 drift."""
+    hi, lo = segment_sum_ds(x[order], gid[order], num_segments)
+    return ds_to_f64(hi, lo)

@@ -1183,6 +1183,9 @@ class JaxExecutor:
         if key_cols:
             keys = [_key_i64(c, dt.alive) for _, c in key_cols]
             gid, order, newgrp = _group_ids(keys)
+            # gid-sorted row order: float sums ride the compensated
+            # segmented scan (ndstpu.engine.df64) instead of f32-drift
+            self._agg_order = order
             ngseg = cap
             # representative (first-in-sorted-order) row per group
             first_pos = jnp.full(cap, cap, jnp.int64).at[
@@ -1199,6 +1202,7 @@ class JaxExecutor:
                                       c.ctype, c.dictionary)
         else:
             gid = jnp.where(dt.alive, 0, 1).astype(jnp.int64)
+            self._agg_order = jnp.argsort(gid, stable=True)
             ngseg = cap
             out_alive = jnp.zeros(cap, bool).at[0].set(True)
             out_cols = {}
@@ -1274,6 +1278,16 @@ class JaxExecutor:
             return JEval(gtable).eval(lowered)
         raise Unsupported(f"aggregate output {type(e).__name__}")
 
+    def _segment_sum_typed(self, vals, gid, ngseg, kind: str):
+        """int/decimal sums stay exact s64 segment_sum; float sums use
+        the compensated segmented scan (TPU computes f64 at f32
+        precision — ndstpu.engine.df64)."""
+        if kind in ("decimal", "int32", "int64"):
+            return jax.ops.segment_sum(vals, gid, num_segments=ngseg)
+        from ndstpu.engine import df64
+        return df64.segment_sum_compensated(vals, gid, ngseg,
+                                            self._agg_order)
+
     def _agg_column(self, dt: DTable, evl: JEval, a: ex.AggExpr, gid, ngseg,
                     out_alive) -> DCol:
         func = a.func
@@ -1296,9 +1310,9 @@ class JaxExecutor:
         got = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                   num_segments=ngseg) > 0
         if func == "sum":
-            sums = jax.ops.segment_sum(
-                _sum_input(c.data, valid, c.ctype.kind), gid,
-                num_segments=ngseg)
+            sums = self._segment_sum_typed(
+                _sum_input(c.data, valid, c.ctype.kind), gid, ngseg,
+                c.ctype.kind)
             if c.ctype.kind == "decimal":
                 return DCol(sums, got, decimal(38, c.ctype.scale))
             if c.ctype.kind in ("int32", "int64"):
@@ -1307,9 +1321,9 @@ class JaxExecutor:
         if func == "avg":
             cnts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                        num_segments=ngseg)
-            sums = jax.ops.segment_sum(
-                _sum_input(c.data, valid, c.ctype.kind), gid,
-                num_segments=ngseg)
+            sums = self._segment_sum_typed(
+                _sum_input(c.data, valid, c.ctype.kind), gid, ngseg,
+                c.ctype.kind)
             data = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
             if c.ctype.kind == "decimal":
                 data = data / (10 ** c.ctype.scale)
@@ -1332,8 +1346,8 @@ class JaxExecutor:
         if func in ("stddev_samp", "var_samp", "stddev", "variance"):
             x = evl.cast(c, FLOAT64).data
             xv = jnp.where(valid, x, 0.0)
-            s1 = jax.ops.segment_sum(xv, gid, num_segments=ngseg)
-            s2 = jax.ops.segment_sum(xv * xv, gid, num_segments=ngseg)
+            s1 = self._segment_sum_typed(xv, gid, ngseg, "float64")
+            s2 = self._segment_sum_typed(xv * xv, gid, ngseg, "float64")
             cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                       num_segments=ngseg)
             ok = cnt > 1

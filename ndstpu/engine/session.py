@@ -119,8 +119,16 @@ class Session:
                 del cache[ck]
                 ent = None
             if ent is not None:
-                self._spmd_used = True
-                return ent[1].execute_again()
+                try:
+                    out = ent[1].execute_again()
+                    self._spmd_used = True
+                    return out
+                except Exception as e:  # noqa: BLE001
+                    # degrade a cached re-execution defect the same way
+                    # as a first-run one: drop the executor, fall back
+                    del cache[ck]
+                    self._record_spmd_error(e)
+                    ent = None
             try:
                 kw = {"dev_cache": self._spmd_dev_cache}
                 if self.spmd_threshold is not None:
@@ -136,6 +144,11 @@ class Session:
                 # plan shape or an expression outside the distributed
                 # subset: the single-chip path below has per-plan fallback
                 pass
+            except Exception as e:  # noqa: BLE001
+                # a distributed-executor defect must degrade to the
+                # single-chip path, not fail the query; keep the error
+                # observable for tests/triage
+                self._record_spmd_error(e)
         if self.backend in ("tpu", "tpu-spmd"):
             exe = self._jax_executor()
             if key is not None:
@@ -143,6 +156,12 @@ class Session:
                     plan, f"{self._views_epoch}|{key}")
             return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
+
+    def _record_spmd_error(self, e: Exception) -> None:
+        errs = getattr(self, "_spmd_errors", None)
+        if errs is None:
+            errs = self._spmd_errors = []
+        errs.append(repr(e))
 
     def _mesh(self):
         m = getattr(self, "_mesh_cache", None)

@@ -52,7 +52,9 @@ from ndstpu.engine.jaxexec import (
     _DEAD_KEY,
     _group_ids,
     _key_i64,
+    _lexsort_order,
     _sum_input,
+    jnp_dtype,
 )
 from ndstpu.parallel.mesh import SHARD_AXIS
 
@@ -83,6 +85,38 @@ class _BroadcastJoin:
     build_empty: bool = False
 
 
+@dataclasses.dataclass
+class _ShuffleJoin:
+    """Partitioned equi-join for build sides too large to broadcast —
+    the fact-fact join path (e.g. store_sales ⋈ store_returns on
+    item_sk+ticket_number).  The build side is hash-partitioned by key
+    across devices on the host (each device holds its partition, sorted
+    by key); the traced probe side repartitions the live spine rows with
+    ``all_to_all`` using the same splitmix64 bucket hash, then joins
+    locally with a searchsorted probe.  This is the Spark shuffle-
+    exchange analog (power_run_cpu.template:30-32) as an ICI collective.
+    """
+    kind: str
+    mark: Optional[str]
+    extra: Optional[ex.Expr]
+    probe_key_exprs: List[ex.Expr]
+    radices: List[Tuple[int, int]]
+    spine_left: bool
+    build_has_null: bool
+    build_empty: bool
+    part_cap: int                    # rows per device partition (padded)
+    # host-staged [n_dev * part_cap] arrays (device_put at spine launch):
+    # partition-local keys sorted ascending, _DEAD_KEY padding
+    keys_flat: np.ndarray
+    # build columns gathered into partition order: name -> (data, valid,
+    # ctype, dictionary)
+    cols_flat: Dict[str, tuple]
+    # filled per trace: index of this join's first arg in the flat
+    # shard_map argument list
+    arg_start: int = -1
+    n_args: int = 0
+
+
 class DistributedPlanExecutor:
     """Compiles + runs one logical plan over the mesh (one-shot object)."""
 
@@ -98,8 +132,17 @@ class DistributedPlanExecutor:
         # shared (table, column, version) -> device arrays cache so many
         # cached query executors don't pin duplicate fact copies in HBM
         self.dev_cache = dev_cache if dev_cache is not None else {}
-        self.joins: Dict[int, _BroadcastJoin] = {}
+        self.joins: Dict[int, object] = {}   # _BroadcastJoin | _ShuffleJoin
         self.fact: Optional[lp.Scan] = None
+        # probe-shuffle receive bucket = slack * capacity / n_dev; doubled
+        # on overflow up to n_dev (lossless) by _run_spine_retrying
+        self.shuffle_slack = 2
+        self._last_dropped = 0
+        self._prepared = False
+        # collect_partials mode: _post_spine returns raw finest-group
+        # partials instead of a finalized Table (union-agg branches)
+        self._emit_partials = False
+        self._union_ctx = None
         # trace-time metadata side channels (static python values)
         self._row_meta: Optional[List[tuple]] = None
         self._key_meta: Optional[List[tuple]] = None
@@ -111,6 +154,9 @@ class DistributedPlanExecutor:
         """Try candidate fact tables largest-first (at tiny scale factors
         a fixed-size dimension like date_dim can out-size the fact, and
         some spines fail preparation, e.g. non-unique build keys)."""
+        union = self._try_union_agg(plan)
+        if union is not None:
+            return union
         scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
         if not scans:
             raise DistUnsupported("no base-table scan in plan")
@@ -121,23 +167,64 @@ class DistributedPlanExecutor:
         for rows, _, target in sized:
             if rows < self.threshold:
                 break
-            for r, _, n in sized:
-                if n is not target and r > self.broadcast_limit:
-                    raise DistUnsupported(
-                        f"second large table {n.table} ({r} rows) "
-                        "exceeds the broadcast limit (fact-fact join)")
             self.joins = {}
             self.fact = None
             self.fact_target = target
+            self._prepared = False
             try:
                 spine, top = self._split(plan)
-                result = self._run_spine(spine)
+                result = self._run_spine_retrying(spine)
             except DistUnsupported as e:
                 last = e
                 continue
             self._spine, self._top = spine, top
             return self._finish(result)
         raise last or DistUnsupported("no sharded-size table in plan")
+
+    def collect_partials(self, plan: lp.Aggregate):
+        """Run an Aggregate-rooted plan over the mesh and return the raw
+        finest-group (key_cols, leaf_parts) instead of finalizing — one
+        branch of a union-all aggregate."""
+        self._emit_partials = True
+        scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
+        if not scans:
+            raise DistUnsupported("no base-table scan in branch")
+        sized = sorted(((self.catalog.get(n.table).num_rows, i, n)
+                        for i, n in enumerate(scans)),
+                       key=lambda t: (-t[0], t[1]))
+        last: Optional[DistUnsupported] = None
+        for rows, _, target in sized:
+            if rows < self.threshold:
+                break
+            self.joins = {}
+            self.fact = None
+            self.fact_target = target
+            self._prepared = False
+            try:
+                spine, top = self._split(plan)
+                if spine is not plan:
+                    raise DistUnsupported(
+                        "branch spine is not the union aggregate")
+                out = self._run_spine_retrying(spine)
+            except DistUnsupported as e:
+                last = e
+                continue
+            self._spine, self._top = spine, top
+            return out
+        raise last or DistUnsupported("no sharded-size table in branch")
+
+    def _run_spine_retrying(self, spine: lp.Plan) -> Table:
+        """Run the spine; if a shuffle-join receive bucket overflowed
+        (key skew), double the slack and re-trace.  slack >= n_dev makes
+        every bucket as large as a whole shard, which cannot drop."""
+        while True:
+            result = self._run_spine(spine)
+            if not self._last_dropped:
+                return result
+            if self.shuffle_slack >= self.n_dev:
+                raise DistUnsupported(
+                    "shuffle join dropped rows at lossless bucket size")
+            self.shuffle_slack = min(self.shuffle_slack * 2, self.n_dev)
 
     def _finish(self, result: Table) -> Table:
         if self._top is None:
@@ -151,8 +238,245 @@ class DistributedPlanExecutor:
         checked catalog versions are unchanged) and redo the host
         finalize + plan tail — the repeat-execution path for cached
         tpu-spmd queries (no re-trace, no re-compile, no host build)."""
+        if self._union_ctx is not None:
+            return self._union_again()
         out = jax.device_get(self._compiled_fn(*self._dev_args))
         return self._finish(self._post_spine(out))
+
+    # -- union-all aggregates ------------------------------------------------
+
+    def _try_union_agg(self, plan: lp.Plan) -> Optional[Table]:
+        """Distribute an Aggregate over a UNION ALL of channel subplans
+        (q5/q33/q56/q60/q66/q71/q76... shape): run each branch as its own
+        sharded spine collecting finest-group partials, then combine the
+        decomposable partials across branches on the host.  Returns None
+        when the plan doesn't match or no branch can be distributed."""
+        found = None
+        for agg in (n for n in plan.walk()
+                    if isinstance(n, lp.Aggregate)):
+            node = agg.child
+            while isinstance(node, (lp.Project, lp.Filter,
+                                    lp.SubqueryAlias)):
+                node = node.child
+            if isinstance(node, lp.SetOp) and node.kind == "union" \
+                    and node.all:
+                found = (agg, node)
+                break
+        if found is None:
+            return None
+        agg, setop = found
+        try:
+            self._check_agg(agg)
+        except DistUnsupported:
+            return None
+        leaves = self._agg_leaves(agg)
+        if any(a.distinct for a in leaves):
+            return None    # cross-branch dedup not supported
+        branches: List[lp.Plan] = []
+
+        def flat(s: lp.SetOp) -> None:
+            for side in (s.left, s.right):
+                if isinstance(side, lp.SetOp) and side.kind == "union" \
+                        and side.all:
+                    flat(side)
+                else:
+                    branches.append(side)
+
+        flat(setop)
+        left_names = _output_names(branches[0], self.catalog)
+        if left_names is None:
+            return None
+        sub_execs: List[Optional[DistributedPlanExecutor]] = []
+        host_plans: List[Optional[lp.Aggregate]] = []
+        parts: List[tuple] = []   # (key_cols, leaf_parts, leaf_meta)
+        any_dist = False
+        for i, b in enumerate(branches):
+            nb = b
+            if i > 0:
+                bn = _output_names(b, self.catalog)
+                if bn is None or len(bn) != len(left_names):
+                    return None
+                # SetOp semantics are positional: align this branch's
+                # output names with the left branch's
+                nb = lp.Project(b, [(ln, ex.ColumnRef(n))
+                                    for ln, n in zip(left_names, bn)])
+            child = _graft(agg.child, setop, nb)
+            bplan = lp.Aggregate(child, list(agg.group_by),
+                                 list(agg.aggs), None)
+            exe = DistributedPlanExecutor(
+                self.catalog, self.mesh, self.threshold,
+                self.broadcast_limit, self.dev_cache)
+            try:
+                kc, lps = exe.collect_partials(bplan)
+                parts.append((kc, lps, list(exe._leaf_meta)))
+                sub_execs.append(exe)
+                host_plans.append(None)
+                any_dist = True
+            except DistUnsupported:
+                try:
+                    kc, lps, meta = self._host_partials(bplan)
+                except Exception:  # noqa: BLE001 — any planner/eval gap
+                    return None    # falls back to the non-union paths
+                parts.append((kc, lps, meta))
+                sub_execs.append(None)
+                host_plans.append(bplan)
+        if not any_dist:
+            return None
+        result = self._finalize_union(agg, leaves, parts)
+        self._union_ctx = (plan, agg, sub_execs, parts, leaves)
+        if agg is plan:
+            return result
+        return self.np_exec.execute(_graft(
+            plan, agg, lp.InlineTable(result, "__dist_union__")))
+
+    def _union_again(self) -> Table:
+        plan, agg, sub_execs, first_parts, leaves = self._union_ctx
+        parts = []
+        for exe, cached in zip(sub_execs, first_parts):
+            if exe is not None:
+                kc, lps = exe.execute_again()
+                parts.append((kc, lps, list(exe._leaf_meta)))
+            else:
+                # host-fallback branch: the caller only reuses this
+                # executor when catalog versions are unchanged, so the
+                # first run's numpy partials are still valid — no
+                # re-execution of the branch subplan
+                parts.append(cached)
+        result = self._finalize_union(agg, leaves, parts)
+        if agg is plan:
+            return result
+        return self.np_exec.execute(_graft(
+            plan, agg, lp.InlineTable(result, "__dist_union__")))
+
+    def _host_partials(self, bplan: lp.Aggregate):
+        """Numpy finest-group partials for one union branch that can't
+        be distributed (sub-threshold fact or unsupported shape)."""
+        rows = self.np_exec.execute(bplan.child)
+        ev = ex.Evaluator(rows)
+        key_cols: Dict[str, Column] = {}
+        for name, e in bplan.group_by:
+            key_cols[name] = ev.eval(
+                self.np_exec._resolve_subqueries(e))
+        n = rows.num_rows
+        if bplan.group_by:
+            gids, first = self.np_exec._factorize(
+                list(key_cols.values()))
+            ng = len(first)
+            key_cols = {name: c.gather(first)
+                        for name, c in key_cols.items()}
+        else:
+            gids = np.zeros(n, np.int64)
+            ng = 1 if n else 0
+        leaves = self._agg_leaves(bplan)
+        leaf_parts, metas = [], []
+        for a in leaves:
+            p, meta = self._host_leaf_partial(rows, ev, a, gids, ng)
+            leaf_parts.append(p)
+            metas.append(meta)
+        return key_cols, leaf_parts, metas
+
+    def _host_leaf_partial(self, rows: Table, ev: ex.Evaluator,
+                           a: ex.AggExpr, gids, ng):
+        """Numpy mirror of the traced _leaf_partial."""
+        if isinstance(a.arg, ex.Star) or a.arg is None:
+            cnt = np.bincount(gids, minlength=ng).astype(np.int64) \
+                if len(gids) else np.zeros(ng, np.int64)
+            return [cnt], (a.func, None, None)
+        c = ev.eval(self.np_exec._resolve_subqueries(a.arg))
+        meta = (a.func, c.ctype, c.dictionary)
+        valid = c.validity()
+        cnt = np.zeros(ng, np.int64)
+        np.add.at(cnt, gids[valid], 1)
+        if a.func == "count":
+            return [cnt], meta
+        if a.func in ("sum", "avg"):
+            if c.ctype.kind in ("decimal", "int32", "int64"):
+                s = np.zeros(ng, np.int64)
+                np.add.at(s, gids[valid], c.data[valid].astype(np.int64))
+            else:
+                s = np.zeros(ng, np.float64)
+                np.add.at(s, gids[valid],
+                          c.data[valid].astype(np.float64))
+            return [s, cnt], meta
+        if a.func in ("min", "max"):
+            if c.ctype.kind == "float64":
+                init = np.inf if a.func == "min" else -np.inf
+                acc = np.full(ng, init, np.float64)
+                vals = c.data[valid].astype(np.float64)
+            else:
+                init = np.int64(_DEAD_KEY if a.func == "min"
+                                else -_DEAD_KEY)
+                acc = np.full(ng, init, np.int64)
+                vals = c.data[valid].astype(np.int64)
+            fold = np.minimum if a.func == "min" else np.maximum
+            fold.at(acc, gids[valid], vals)
+            return [acc, cnt], meta
+        # stddev family
+        x = c.data[valid].astype(np.float64)
+        if c.ctype.kind == "decimal":
+            x = x / (10 ** c.ctype.scale)
+        s1 = np.zeros(ng, np.float64)
+        s2 = np.zeros(ng, np.float64)
+        np.add.at(s1, gids[valid], x)
+        np.add.at(s2, gids[valid], x * x)
+        return [s1, s2, cnt], meta
+
+    def _finalize_union(self, agg: lp.Aggregate, leaves,
+                        parts: List[tuple]) -> Table:
+        """Concatenate per-branch finest groups and re-combine through
+        the grouping-sets machinery (a plain GROUP BY is the single
+        all-keys grouping set)."""
+        names = [n for n, _ in agg.group_by]
+        # merge group-key columns (Table.concat merges dictionaries)
+        if names:
+            merged = Table.concat([Table(kc) for kc, _, _ in parts])
+            key_cols = dict(merged.columns)
+        else:
+            key_cols = {}
+        leaf_parts: List[List[np.ndarray]] = []
+        metas: List[tuple] = []
+        for li, a in enumerate(leaves):
+            bmetas = [m[li] for _, _, m in parts]
+            func, ct0, _ = bmetas[0]
+            for f2, ct2, _ in bmetas[1:]:
+                if f2 != func or ct2 != ct0:
+                    raise DistUnsupported(
+                        "union branches disagree on aggregate type")
+            dicts = [m[li][2] for _, _, m in parts]
+            has_dict = any(d is not None for d in dicts)
+            merged_dict = None
+            branch_parts = [lp_[li] for _, lp_, _ in parts]
+            if has_dict and func in ("min", "max"):
+                # per-branch dictionary codes are not comparable across
+                # branches: translate into the union dictionary
+                arrs = [d for d in dicts if d is not None]
+                merged_dict = arrs[0]
+                for d in arrs[1:]:
+                    merged_dict = np.union1d(merged_dict, d)
+                init = np.int64(_DEAD_KEY if func == "min"
+                                else -_DEAD_KEY)
+                for bi, (bp, d) in enumerate(zip(branch_parts, dicts)):
+                    if d is None:
+                        continue
+                    codes = bp[0]
+                    cnt = bp[1]
+                    safe = np.clip(codes, 0, len(d) - 1).astype(np.int64)
+                    remap = np.searchsorted(
+                        merged_dict, d[safe]).astype(np.int64)
+                    branch_parts[bi] = [np.where(cnt > 0, remap, init)] \
+                        + list(bp[1:])
+            cat = [np.concatenate([bp[pi] for bp in branch_parts])
+                   for pi in range(len(branch_parts[0]))]
+            leaf_parts.append(cat)
+            metas.append((func, ct0, merged_dict if merged_dict
+                          is not None else dicts[0]))
+        self._leaf_meta = metas
+        sets = agg.grouping_sets if agg.grouping_sets is not None \
+            else [list(range(len(names)))]
+        shim = lp.Aggregate(agg.child, list(agg.group_by),
+                            list(agg.aggs), sets)
+        return self._grouping_sets_result(shim, leaves, key_cols,
+                                          leaf_parts)
 
     # -- plan analysis -------------------------------------------------------
 
@@ -183,36 +507,47 @@ class DistributedPlanExecutor:
                                     "nullaware_anti", "mark")
             return isinstance(node, _SPINE_NODES)
 
-        agg_i = next((i for i, nd in enumerate(chain)
-                      if isinstance(nd, lp.Aggregate)), None)
-        if agg_i is not None:
-            for nd in chain[agg_i + 1:]:
-                if not spine_ok(nd):
-                    raise DistUnsupported(
-                        f"{type(nd).__name__} below spine aggregate")
-            self._check_agg(chain[agg_i])
-            spine = chain[agg_i]
+        # longest spine-ok suffix of the chain ending at the fact scan;
+        # if the node directly above it is a supported Aggregate, take it
+        # as the spine top (the DEEPEST aggregate — everything above,
+        # including outer aggregates/windows over the now-small result,
+        # runs on the host tail)
+        ok_from = len(chain) - 1
+        for i in range(len(chain) - 1, -1, -1):
+            if spine_ok(chain[i]):
+                ok_from = i
+            else:
+                break
+        if ok_from > 0 and isinstance(chain[ok_from - 1], lp.Aggregate):
+            self._check_agg(chain[ok_from - 1])
+            spine = chain[ok_from - 1]
         else:
-            ok_from = len(chain) - 1
-            for i in range(len(chain) - 1, -1, -1):
-                if spine_ok(chain[i]):
-                    ok_from = i
-                else:
-                    break
             spine = chain[ok_from]
+        if not isinstance(spine, lp.Aggregate) and not any(
+                isinstance(nd, (lp.Join, lp.Filter)) or
+                (isinstance(nd, lp.Scan) and nd.predicate is not None)
+                for nd in spine.walk()):
+            # a pass-through row spine (bare scan/project) would shard
+            # the fact only to ship every row straight back to the host
+            raise DistUnsupported("row spine does no distributed work")
         top = plan if spine is not plan else None
         return spine, top
 
     def _check_agg(self, node: lp.Aggregate) -> None:
-        if node.grouping_sets is not None:
-            raise DistUnsupported("grouping sets on spine")
         for _, e in node.aggs:
             for sub in e.walk():
                 if isinstance(sub, ex.AggExpr):
-                    if sub.distinct:
-                        raise DistUnsupported("distinct agg on spine")
                     if sub.func not in _AGG_FUNCS:
                         raise DistUnsupported(f"agg {sub.func} on spine")
+                    if sub.distinct and (isinstance(sub.arg, ex.Star)
+                                         or sub.arg is None):
+                        raise DistUnsupported("distinct star agg")
+                    if sub.distinct and node.grouping_sets is not None:
+                        # a distinct count at the finest grouping cannot
+                        # be re-combined into coarser rollup groups (the
+                        # same value can occur under many fine groups)
+                        raise DistUnsupported(
+                            "distinct agg under grouping sets")
                 if isinstance(sub, ex.WindowExpr):
                     raise DistUnsupported("window inside aggregate")
 
@@ -300,30 +635,75 @@ class DistributedPlanExecutor:
                 # arbitrary duplicate, so a residual would be evaluated
                 # against one of many candidate rows
                 raise DistUnsupported(
-                    f"non-unique build keys for {kind} broadcast join")
-            self.joins[id(p)] = _BroadcastJoin(
-                kind, p.mark, p.extra, probe_exprs, radices, skeys,
-                row_of, build, on_left,
-                build_has_null=bool((~bvalid).any()),
-                build_empty=build.num_rows == 0)
+                    f"non-unique build keys for {kind} join")
+            if build.num_rows > self.broadcast_limit:
+                self.joins[id(p)] = self._stage_shuffle_join(
+                    p, kind, probe_exprs, radices, skeys, row_of, build,
+                    on_left, bool((~bvalid).any()))
+            else:
+                self.joins[id(p)] = _BroadcastJoin(
+                    kind, p.mark, p.extra, probe_exprs, radices, skeys,
+                    row_of, build, on_left,
+                    build_has_null=bool((~bvalid).any()),
+                    build_empty=build.num_rows == 0)
             return True
         spine = False
         for c in p.children():
             spine = self._prepare(c) or spine
         return spine
 
+    def _stage_shuffle_join(self, p: lp.Join, kind: str, probe_exprs,
+                            radices, skeys: np.ndarray, row_of: np.ndarray,
+                            build: Table, on_left: bool,
+                            build_has_null: bool) -> _ShuffleJoin:
+        """Hash-partition the (too-large-to-broadcast) build side across
+        devices by the same splitmix64 bucket hash the traced probe
+        shuffle uses; each partition is sorted by key for a local
+        searchsorted probe, and build columns are gathered into
+        partition order so the probe position indexes them directly."""
+        from ndstpu.parallel import exchange
+        nd = self.n_dev
+        dest = (exchange.mix64_np(skeys.astype(np.uint64))
+                % np.uint64(nd)).astype(np.int64)
+        order = np.lexsort((skeys, dest))
+        counts = np.bincount(dest, minlength=nd)
+        part_cap = max(int(counts.max()) if len(skeys) else 0, 1)
+        offs = np.concatenate([[0], np.cumsum(counts)])
+        within = np.arange(len(skeys)) - offs[dest[order]]
+        slot = dest[order] * part_cap + within
+        keys_flat = np.full(nd * part_cap, _DEAD_KEY, np.int64)
+        keys_flat[slot] = skeys[order]
+        rowsel = row_of[order]
+        cols_flat: Dict[str, tuple] = {}
+        for name in build.column_names:
+            c = build.column(name)
+            data = np.zeros(nd * part_cap, c.data.dtype)
+            valid = np.zeros(nd * part_cap, bool)
+            data[slot] = c.data[rowsel]
+            valid[slot] = c.validity()[rowsel]
+            cols_flat[name] = (data, valid, c.ctype, c.dictionary)
+        return _ShuffleJoin(
+            kind, p.mark, p.extra, probe_exprs, radices, on_left,
+            build_has_null, build.num_rows == 0, part_cap, keys_flat,
+            cols_flat)
+
     # -- spine execution -----------------------------------------------------
 
     def _run_spine(self, spine: lp.Plan) -> Table:
         agg = spine if isinstance(spine, lp.Aggregate) else None
         row_head = agg.child if agg is not None else spine
-        self._resolve_all(row_head)
-        if agg is not None:
-            for _, e in agg.aggs + agg.group_by:
-                for sub in e.walk():
-                    if isinstance(sub, ex.SubqueryExpr):
-                        raise DistUnsupported("subquery above row spine")
-        self._prepare(row_head)
+        if not self._prepared:
+            # host-side join staging runs ONCE per plan: skew retries
+            # re-enter only to re-trace with a larger bucket slack
+            self._resolve_all(row_head)
+            if agg is not None:
+                for _, e in agg.aggs + agg.group_by:
+                    for sub in e.walk():
+                        if isinstance(sub, ex.SubqueryExpr):
+                            raise DistUnsupported(
+                                "subquery above row spine")
+            self._prepare(row_head)
+            self._prepared = True
         if self.fact is None:
             raise DistUnsupported("no sharded scan on spine")
         fact_table = self.catalog.get(self.fact.table)
@@ -366,18 +746,56 @@ class DistributedPlanExecutor:
             al = jax.device_put(alive, row_sh)
             self.dev_cache[akey] = al
         dev_args.append(al)
-        n_args = len(dev_args)
         self._fact_metas = metas
 
+        # shuffle-join build partitions ride in as extra sharded args
+        # (closure constants would be replicated on every device)
+        for sj in self.joins.values():
+            if not isinstance(sj, _ShuffleJoin):
+                continue
+            sj.arg_start = len(dev_args)
+            sj.n_args = 1 + 2 * len(sj.cols_flat)
+            # cached on the join object (skew retries re-enter here) —
+            # NOT in the shared dev_cache, whose id()-keyed entries could
+            # alias a recycled object id from a dead executor
+            dev = getattr(sj, "_dev", None)
+            if dev is None:
+                staged = [sj.keys_flat] + [
+                    a for (d, v, _, _) in sj.cols_flat.values()
+                    for a in (d, v)]
+                dev = sj._dev = [jax.device_put(a, row_sh)
+                                 for a in staged]
+                # the device copies are the only ones read from here on;
+                # drop the host staging arrays (a whole padded build side)
+                # but keep the per-column (ctype, dictionary) metadata
+                sj.keys_flat = None
+                sj.cols_flat = {nm: (None, None, ct, dic)
+                                for nm, (_d, _v, ct, dic)
+                                in sj.cols_flat.items()}
+            dev_args += dev
+        n_args = len(dev_args)
+
         agg_leaves = self._agg_leaves(agg) if agg is not None else []
+        has_distinct = any(a.distinct for a in agg_leaves)
 
         def body(*args):
-            col_args, alive_arg = args[:-1], args[-1]
+            self._cur_args = args
+            self._drop_terms = []
+            nf = len(metas)
+            col_args, alive_arg = args[:2 * nf], args[2 * nf]
             dcols = {}
             for i, (name, ctype, dictionary) in enumerate(metas):
                 dcols[name] = DCol(col_args[2 * i], col_args[2 * i + 1],
                                    ctype, dictionary)
             dt = self._exec(row_head, DTable(dcols, alive_arg))
+            if has_distinct:
+                # DISTINCT needs every row of a group on one device:
+                # exchange rows by group-key hash so the local sort-dedup
+                # in _leaf_partial is globally exact (the Spark distinct
+                # exchange as an ICI all_to_all)
+                dt = self._colocate_by_group(agg, dt)
+            dropped = sum(self._drop_terms) if self._drop_terms \
+                else jnp.int64(0)
             if agg is None:
                 self._row_meta = [(nm, dt.columns[nm].ctype,
                                    dt.columns[nm].dictionary)
@@ -385,13 +803,13 @@ class DistributedPlanExecutor:
                 flat = []
                 for nm in dt.column_names:
                     flat += [dt.columns[nm].data, dt.columns[nm].valid]
-                return tuple(flat) + (dt.alive,)
-            return self._agg_partials(agg, agg_leaves, dt)
+                return tuple(flat) + (dt.alive,), dropped
+            return self._agg_partials(agg, agg_leaves, dt), dropped
 
         sharded = shard_map(
             body, mesh=self.mesh,
             in_specs=tuple(P(SHARD_AXIS) for _ in range(n_args)),
-            out_specs=P(SHARD_AXIS) if agg is None else P(),
+            out_specs=((P(SHARD_AXIS) if agg is None else P()), P()),
             check_vma=False)
         self._agg_ctx = (agg, agg_leaves)
         self._compiled_fn = jax.jit(sharded)
@@ -399,10 +817,16 @@ class DistributedPlanExecutor:
         out = jax.device_get(self._compiled_fn(*dev_args))
         return self._post_spine(out)
 
-    def _post_spine(self, out) -> Table:
+    def _post_spine(self, out):
+        out, dropped = out
+        self._last_dropped = int(np.asarray(dropped))
         agg, agg_leaves = self._agg_ctx
         if agg is not None:
-            return self._finalize_agg(agg, agg_leaves, out)
+            key_cols, leaf_parts = self._unpack_agg(out)
+            if self._emit_partials:
+                return key_cols, leaf_parts
+            return self._finalize_from(agg, agg_leaves, key_cols,
+                                       leaf_parts)
         flat, alive_out = out[:-1], np.asarray(out[-1])
         sel = np.nonzero(alive_out)[0]
         res = {}
@@ -440,16 +864,18 @@ class DistributedPlanExecutor:
             if bj is None:
                 raise DistUnsupported("unprepared join on spine")
             dt = self._exec(p.left if bj.spine_left else p.right, dt)
+            if isinstance(bj, _ShuffleJoin):
+                return self._shuffle_join(bj, dt)
             return self._broadcast_join(bj, dt)
         raise DistUnsupported(f"{type(p).__name__} in traced spine")
 
-    def _broadcast_join(self, bj: _BroadcastJoin, dt: DTable) -> DTable:
-        evl = JEval(dt)
-        cap = dt.capacity
+    def _probe_keys(self, evl: JEval, key_exprs, radices, cap):
+        """Radix-encode the probe-side key parts into one int64 plus
+        NULL/out-of-domain masks (shared by broadcast + shuffle joins)."""
         pkey = jnp.zeros(cap, jnp.int64)
         pnull = jnp.zeros(cap, bool)
         in_dom = jnp.ones(cap, bool)
-        for e, (lo, span) in zip(bj.probe_key_exprs, bj.radices):
+        for e, (lo, span) in zip(key_exprs, radices):
             c = evl.eval(e)
             if c.ctype.kind not in _KEY_KINDS:
                 raise DistUnsupported(f"{c.ctype.kind} probe key")
@@ -457,22 +883,106 @@ class DistributedPlanExecutor:
             pnull |= ~c.valid
             in_dom &= (part >= lo) & (part < lo + span - 1)
             pkey = pkey * span + jnp.clip(part - lo, 0, span - 1) + 1
+        return pkey, pnull, in_dom
+
+    def _shuffle_join(self, sj: _ShuffleJoin, dt: DTable) -> DTable:
+        """all_to_all the live spine rows to the device owning their key
+        bucket, then probe this device's sorted build partition."""
+        from ndstpu.parallel import exchange
+        cap = dt.capacity
+        pkey, pnull, in_dom = self._probe_keys(
+            JEval(dt), sj.probe_key_exprs, sj.radices, cap)
+        pok = ~pnull & in_dom
+        # keyless-but-alive rows (NULL / out-of-domain) stay local: they
+        # can't match anywhere but must survive left/anti/mark joins
+        my = lax.axis_index(SHARD_AXIS).astype(jnp.int32)
+        dest = jnp.where(
+            pok,
+            (exchange._mix64(pkey) % jnp.uint64(self.n_dev))
+            .astype(jnp.int32),
+            my)
+        bucket_cap = max(16, -(-(cap * self.shuffle_slack) // self.n_dev))
+        metas = [(n, c.ctype, c.dictionary) for n, c in dt.columns.items()]
+        cols = {}
+        for name, c in dt.columns.items():
+            cols["d" + name] = c.data
+            cols["v" + name] = c.valid
+        cols["__pkey"] = pkey
+        cols["__pok"] = pok
+        cols["__pnull"] = pnull
+        shuf, alive, n_dropped = exchange.repartition_by_dest(
+            cols, dest, dt.alive, self.n_dev, bucket_cap)
+        self._drop_terms.append(n_dropped)
+        ncap = self.n_dev * bucket_cap
+        dcols = {n: DCol(shuf["d" + n], shuf["v" + n], ct, dic)
+                 for n, ct, dic in metas}
+        pkey = shuf["__pkey"]
+        pnull = shuf["__pnull"]
+        pok = shuf["__pok"] & alive
+        # local probe: this device's partition slice of the staged args
+        sl = self._cur_args[sj.arg_start: sj.arg_start + sj.n_args]
+        lkeys = sl[0]
+        pos = jnp.searchsorted(lkeys, pkey)
+        posc = jnp.clip(pos, 0, lkeys.shape[0] - 1)
+        found = (lkeys[posc] == pkey) & pok
+        bcols: Dict[str, DCol] = {}
+        for i, (name, (_d, _v, ct, dic)) in enumerate(
+                sj.cols_flat.items()):
+            bcols[name] = DCol(sl[1 + 2 * i][posc],
+                               sl[2 + 2 * i][posc] & found, ct, dic)
+        combined = DTable({**dcols, **bcols}, alive)
+        if sj.extra is not None:
+            found = found & JEval(combined).predicate(sj.extra)
+            bcols = {n: DCol(c.data, c.valid & found, c.ctype,
+                             c.dictionary) for n, c in bcols.items()}
+            combined = DTable({**dcols, **bcols}, alive)
+        if sj.kind == "inner":
+            return DTable(combined.columns, alive & found)
+        if sj.kind == "left":
+            return combined
+        if sj.kind == "semi":
+            return DTable(dcols, alive & found)
+        if sj.kind == "anti":
+            return DTable(dcols, alive & ~found)
+        if sj.kind == "nullaware_anti":
+            if sj.extra is not None:
+                raise DistUnsupported("residual on nullaware anti join")
+            if sj.build_has_null:   # NOT IN (... NULL ...): never TRUE
+                return DTable(dcols, jnp.zeros(ncap, bool))
+            if sj.build_empty:      # NOT IN (empty): keep everything
+                return DTable(dcols, alive)
+            return DTable(dcols, alive & ~found & ~pnull)
+        # mark
+        out = dict(dcols)
+        out[sj.mark] = DCol(found, jnp.ones(ncap, bool), BOOL)
+        return DTable(out, alive)
+
+    def _broadcast_join(self, bj: _BroadcastJoin, dt: DTable) -> DTable:
+        cap = dt.capacity
+        pkey, pnull, in_dom = self._probe_keys(
+            JEval(dt), bj.probe_key_exprs, bj.radices, cap)
         pvalid = ~pnull & in_dom & dt.alive
+        bcols: Dict[str, DCol] = {}
         if len(bj.sorted_keys) == 0:
+            # empty build side (a filter left no rows): no matches, and
+            # there is nothing to gather from — emit typed NULL columns
             found = jnp.zeros(cap, bool)
-            bidx = jnp.zeros(cap, jnp.int64)
+            for name in bj.build.column_names:
+                c = bj.build.column(name)
+                data = jnp.zeros(cap, jnp_dtype(c.ctype))
+                bcols[name] = DCol(data, jnp.zeros(cap, bool), c.ctype,
+                                   c.dictionary)
         else:
             skeys = jnp.asarray(bj.sorted_keys)
             pos = jnp.searchsorted(skeys, pkey)
             posc = jnp.clip(pos, 0, len(bj.sorted_keys) - 1)
             found = (skeys[posc] == pkey) & pvalid
             bidx = jnp.asarray(bj.row_of)[posc]
-        bcols: Dict[str, DCol] = {}
-        for name in bj.build.column_names:
-            c = bj.build.column(name)
-            data = jnp.asarray(c.data)[bidx]
-            valid = jnp.asarray(c.validity())[bidx] & found
-            bcols[name] = DCol(data, valid, c.ctype, c.dictionary)
+            for name in bj.build.column_names:
+                c = bj.build.column(name)
+                data = jnp.asarray(c.data)[bidx]
+                valid = jnp.asarray(c.validity())[bidx] & found
+                bcols[name] = DCol(data, valid, c.ctype, c.dictionary)
         combined = DTable({**dt.columns, **bcols}, dt.alive)
         if bj.extra is not None:
             found = found & JEval(combined).predicate(bj.extra)
@@ -501,6 +1011,36 @@ class DistributedPlanExecutor:
         return DTable(cols, dt.alive)
 
     # -- distributed aggregation ---------------------------------------------
+
+    def _colocate_by_group(self, agg: lp.Aggregate, dt: DTable) -> DTable:
+        """Repartition live rows so every row of one group lands on the
+        device owning hash(group keys)."""
+        from ndstpu.parallel import exchange
+        evl = JEval(dt)
+        cap = dt.capacity
+        keys = [_key_i64(evl.eval(e), dt.alive) for _, e in agg.group_by]
+        h = jnp.zeros(cap, jnp.uint64)
+        for k in keys:
+            # float64 group keys keep their float encoding in _key_i64;
+            # hash their bits via int64 round-trip is unavailable on TPU,
+            # so quantize through int64 cast (collisions only merge
+            # devices, never corrupt results — grouping re-checks keys)
+            ki = k.astype(jnp.int64) if k.dtype != jnp.int64 else k
+            h = exchange._mix64(h ^ exchange._mix64(ki.astype(jnp.uint64)))
+        dest = (h % jnp.uint64(self.n_dev)).astype(jnp.int32) \
+            if keys else jnp.zeros(cap, jnp.int32)
+        bucket_cap = max(16, -(-(cap * self.shuffle_slack) // self.n_dev))
+        metas = [(n, c.ctype, c.dictionary)
+                 for n, c in dt.columns.items()]
+        cols = {}
+        for name, c in dt.columns.items():
+            cols["d" + name] = c.data
+            cols["v" + name] = c.valid
+        shuf, alive, n_dropped = exchange.repartition_by_dest(
+            cols, dest, dt.alive, self.n_dev, bucket_cap)
+        self._drop_terms.append(n_dropped)
+        return DTable({n: DCol(shuf["d" + n], shuf["v" + n], ct, dic)
+                       for n, ct, dic in metas}, alive)
 
     @staticmethod
     def _agg_leaves(agg: lp.Aggregate) -> List[ex.AggExpr]:
@@ -548,7 +1088,7 @@ class DistributedPlanExecutor:
         self._leaf_meta = []
         g_leaves = []
         for a in leaves:
-            parts, meta = self._leaf_partial(dt, evl, a, gid, cap)
+            parts, meta = self._leaf_partial(dt, evl, a, gid, cap, order)
             self._leaf_meta.append(meta)
             g_leaves.append([gather(p) for p in parts])
 
@@ -572,8 +1112,15 @@ class DistributedPlanExecutor:
         return tuple(flat)
 
     def _leaf_partial(self, dt: DTable, evl: JEval, a: ex.AggExpr, gid,
-                      cap):
-        """Per-slot partial arrays + static meta for one leaf aggregate."""
+                      cap, order):
+        """Per-slot partial arrays + static meta for one leaf aggregate.
+        ``order`` sorts rows by gid — float sums use the compensated
+        segmented scan (TPU f64 runs at f32 precision; df64 module)."""
+
+        def fsum(vals):
+            from ndstpu.engine import df64
+            return df64.segment_sum_compensated(vals, gid, cap, order)
+
         alive = dt.alive
         if isinstance(a.arg, ex.Star) or a.arg is None:
             cnt = jax.ops.segment_sum(alive.astype(jnp.int64), gid,
@@ -582,14 +1129,29 @@ class DistributedPlanExecutor:
         c = evl.eval(a.arg)
         meta = (a.func, c.ctype, c.dictionary)
         valid = c.valid & alive
+        if a.distinct:
+            # rows were colocated by group key: keep only the first
+            # (gid, value) occurrence on this device — globally unique.
+            # dorder must NOT shadow `order` — fsum's compensated scan
+            # requires the gid-sorted order, not this dedup order
+            g2 = jnp.where(valid, gid, jnp.int64(cap))
+            xkey = _key_i64(c, valid)
+            dorder = _lexsort_order([g2, xkey])
+            gs, xs = g2[dorder], xkey[dorder]
+            first = jnp.ones(cap, bool).at[1:].set(
+                (gs[1:] != gs[:-1]) | (xs[1:] != xs[:-1]))
+            valid = valid & jnp.zeros(cap, bool).at[dorder].set(
+                first & (gs < cap))
         cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                   num_segments=cap)
         if a.func == "count":
             return [cnt], meta
         if a.func in ("sum", "avg"):
-            s = jax.ops.segment_sum(
-                _sum_input(c.data, valid, c.ctype.kind), gid,
-                num_segments=cap)
+            si = _sum_input(c.data, valid, c.ctype.kind)
+            if c.ctype.kind in ("decimal", "int32", "int64"):
+                s = jax.ops.segment_sum(si, gid, num_segments=cap)
+            else:
+                s = fsum(si)
             return [s, cnt], meta
         if a.func in ("min", "max"):
             if c.ctype.kind == "float64":
@@ -606,8 +1168,8 @@ class DistributedPlanExecutor:
         x = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
         if c.ctype.kind == "decimal":
             x = x / (10 ** c.ctype.scale)
-        s1 = jax.ops.segment_sum(x, gid, num_segments=cap)
-        s2 = jax.ops.segment_sum(x * x, gid, num_segments=cap)
+        s1 = fsum(x)
+        s2 = fsum(x * x)
         return [s1, s2, cnt], meta
 
     def _combine_partials(self, a: ex.AggExpr, parts, fgid, total,
@@ -638,7 +1200,9 @@ class DistributedPlanExecutor:
                        "stddev_samp": 3, "var_samp": 3, "stddev": 3,
                        "variance": 3}
 
-    def _finalize_agg(self, agg: lp.Aggregate, leaves, out) -> Table:
+    def _unpack_agg(self, out):
+        """Flat replicated spine output -> per-finest-group key Columns
+        and raw leaf partial arrays."""
         flat = [np.asarray(a) for a in out]
         final_alive = flat[0]
         sel = np.nonzero(final_alive)[0]
@@ -649,16 +1213,27 @@ class DistributedPlanExecutor:
             pos += 2
             key_cols[name] = Column(
                 data, ctype, None if valid.all() else valid, dictionary)
-        leaf_final: Dict[int, Column] = {}
-        for li, (a, meta) in enumerate(zip(leaves, self._leaf_meta)):
-            func, ctype, dictionary = meta
+        leaf_parts: List[List[np.ndarray]] = []
+        for a, meta in zip(self._agg_ctx[1], self._leaf_meta):
+            func, _ctype, _dictionary = meta
             nparts = self._PARTS_PER_FUNC[func] if not (
                 isinstance(a.arg, ex.Star) or a.arg is None) else 1
-            parts = [flat[pos + k][sel] for k in range(nparts)]
+            leaf_parts.append([flat[pos + k][sel] for k in range(nparts)])
             pos += nparts
-            leaf_final[li] = self._finalize_leaf(a, meta, parts)
+        return key_cols, leaf_parts
 
-        if not agg.group_by and len(sel) == 0:
+    def _finalize_from(self, agg: lp.Aggregate, leaves, key_cols,
+                       leaf_parts) -> Table:
+        if agg.grouping_sets is not None:
+            return self._grouping_sets_result(agg, leaves, key_cols,
+                                              leaf_parts)
+        leaf_final = {li: self._finalize_leaf(a, meta, parts)
+                      for li, (a, meta, parts) in enumerate(
+                          zip(leaves, self._leaf_meta, leaf_parts))}
+        n_fine = len(next(iter(key_cols.values())).data) if key_cols \
+            else (len(leaf_parts[0][0]) if leaf_parts else 0)
+
+        if not agg.group_by and n_fine == 0:
             # SQL global aggregate over zero rows: one row, count 0 / NULL
             for li, (a, meta) in enumerate(zip(leaves, self._leaf_meta)):
                 c = leaf_final[li]
@@ -680,28 +1255,123 @@ class DistributedPlanExecutor:
                 self._lower_expr(e, leaves))
         return Table(out_cols)
 
-    def _lower_expr(self, e: ex.Expr, leaves) -> ex.Expr:
+    def _grouping_sets_result(self, agg: lp.Aggregate, leaves,
+                              key_cols: Dict[str, Column],
+                              leaf_parts) -> Table:
+        """ROLLUP/grouping sets: the spine aggregated at the FINEST
+        grouping (all keys); each set re-combines those decomposable
+        partials on the host (sums add, counts add, min/max fold,
+        moments add) — never re-touching the fact rows — then finalizes
+        and evaluates the output expressions with ``grouping()``
+        resolved per set (Spark semantics, reference rollup queries
+        e.g. q18/q22/q27/q36/q67/q70/q86)."""
+        names = [n for n, _ in agg.group_by]
+        n_fine = len(key_cols[names[0]].data) if names else (
+            len(leaf_parts[0][0]) if leaf_parts else 0)
+        outs: List[Table] = []
+        for subset in agg.grouping_sets:
+            sub_keys: List[Tuple[str, Column]] = []
+            for i, name in enumerate(names):
+                c = key_cols[name]
+                if i in subset:
+                    sub_keys.append((name, c))
+                else:
+                    sub_keys.append((name, Column(
+                        np.zeros_like(c.data), c.ctype,
+                        np.zeros(n_fine, bool), c.dictionary)))
+            if names:
+                gids, first = self.np_exec._factorize(
+                    [c for _, c in sub_keys])
+                ng = len(first)
+            else:
+                # global aggregate: one output row even over no groups
+                gids = np.zeros(n_fine, np.int64)
+                first = np.zeros(1, np.int64)
+                ng = 1
+            out_cols: Dict[str, Column] = {}
+            for name, c in sub_keys:
+                out_cols[name] = c.gather(first) if n_fine else Column(
+                    np.zeros(0, c.data.dtype), c.ctype,
+                    np.zeros(0, bool), c.dictionary)
+            leaf_final: Dict[int, Column] = {}
+            for li, (a, meta, parts) in enumerate(
+                    zip(leaves, self._leaf_meta, leaf_parts)):
+                combined = self._combine_host(a, meta, parts, gids, ng)
+                leaf_final[li] = self._finalize_leaf(a, meta, combined)
+            # leaf columns are per-group (ng); key cols were gathered to
+            # group granularity above — evaluate outputs at that grain
+            gtable = Table({**out_cols,
+                            **{f"__agg{li}": c
+                               for li, c in leaf_final.items()}})
+            for name, e in agg.aggs:
+                out_cols[name] = ex.Evaluator(gtable).eval(
+                    self._lower_expr(e, leaves, gctx=(names, subset)))
+            outs.append(Table(out_cols))
+        return Table.concat(outs)
+
+    def _combine_host(self, a: ex.AggExpr, meta, parts, gids, ng):
+        """Numpy re-combine of finest-group partials into one grouping
+        set's groups (mirror of the traced _combine_partials)."""
+        func = meta[0]
+        has_arg = not (isinstance(a.arg, ex.Star) or a.arg is None)
+        cnt = parts[-1] if has_arg and func != "count" else parts[0]
+        out = []
+        for pi, part in enumerate(parts):
+            if func in ("min", "max") and pi == 0 and has_arg:
+                if part.dtype == np.float64:
+                    init = np.inf if func == "min" else -np.inf
+                else:
+                    init = np.int64(_DEAD_KEY if func == "min"
+                                    else -_DEAD_KEY)
+                acc = np.full(ng, init, part.dtype)
+                fold = np.minimum if func == "min" else np.maximum
+                vals = np.where(cnt > 0, part, init)
+                fold.at(acc, gids, vals)
+                out.append(acc)
+            else:
+                acc = np.zeros(ng, part.dtype)
+                np.add.at(acc, gids, part)
+                out.append(acc)
+        return out
+
+    def _lower_expr(self, e: ex.Expr, leaves,
+                    gctx: Optional[tuple] = None) -> ex.Expr:
         for li, a in enumerate(leaves):
             if a is e:
                 return ex.ColumnRef(f"__agg{li}")
         if isinstance(e, ex.BinOp):
-            return ex.BinOp(e.op, self._lower_expr(e.left, leaves),
-                            self._lower_expr(e.right, leaves))
+            return ex.BinOp(e.op, self._lower_expr(e.left, leaves, gctx),
+                            self._lower_expr(e.right, leaves, gctx))
         if isinstance(e, ex.UnaryOp):
-            return ex.UnaryOp(e.op, self._lower_expr(e.operand, leaves))
+            return ex.UnaryOp(e.op,
+                              self._lower_expr(e.operand, leaves, gctx))
         if isinstance(e, ex.Cast):
-            return ex.Cast(self._lower_expr(e.operand, leaves), e.target)
+            return ex.Cast(self._lower_expr(e.operand, leaves, gctx),
+                           e.target)
         if isinstance(e, ex.Func):
-            return ex.Func(e.name, tuple(self._lower_expr(a, leaves)
+            if e.name == "grouping":
+                # grouping(key) = 0 when the key participates in this
+                # grouping set, 1 when rolled up (Spark semantics,
+                # mirror of physical._eval_agg)
+                if gctx is None:
+                    return ex.Literal(0)
+                names, subset = gctx
+                arg = e.args[0]
+                idx = names.index(arg.name) if isinstance(
+                    arg, ex.ColumnRef) and arg.name in names else -1
+                active = subset is None or idx in subset
+                return ex.Literal(0 if active else 1)
+            return ex.Func(e.name, tuple(self._lower_expr(a, leaves, gctx)
                                          for a in e.args))
         if isinstance(e, ex.Case):
             return ex.Case(
-                tuple((self._lower_expr(c, leaves),
-                       self._lower_expr(v, leaves)) for c, v in e.whens),
-                self._lower_expr(e.default, leaves)
+                tuple((self._lower_expr(c, leaves, gctx),
+                       self._lower_expr(v, leaves, gctx))
+                      for c, v in e.whens),
+                self._lower_expr(e.default, leaves, gctx)
                 if e.default is not None else None)
         if isinstance(e, ex.InList):
-            return ex.InList(self._lower_expr(e.operand, leaves),
+            return ex.InList(self._lower_expr(e.operand, leaves, gctx),
                              e.values, e.negated)
         if isinstance(e, ex.AggExpr):
             # an aggregate leaf the collection pass missed — bail to the
@@ -747,6 +1417,46 @@ class DistributedPlanExecutor:
             0.0) / denom
         data = var if func in ("var_samp", "variance") else np.sqrt(var)
         return Column(data, FLOAT64, None if ok.all() else ok)
+
+
+def _output_names(p: lp.Plan, catalog) -> Optional[List[str]]:
+    """Static output column names of a plan (mirror of how the numpy
+    executor names each node's output), or None when unknown."""
+    if isinstance(p, lp.Scan):
+        if p.columns is not None:
+            return list(p.columns) or \
+                [catalog.get(p.table).column_names[0]]
+        return list(catalog.get(p.table).column_names)
+    if isinstance(p, lp.InlineTable):
+        return list(p.table.column_names)
+    if isinstance(p, lp.Project):
+        return [n for n, _ in p.exprs]
+    if isinstance(p, lp.Aggregate):
+        return [n for n, _ in p.group_by] + [n for n, _ in p.aggs]
+    if isinstance(p, lp.Window):
+        base = _output_names(p.child, catalog)
+        if base is None:
+            return None
+        return base + [n for n, _ in p.exprs if n not in base]
+    if isinstance(p, (lp.Filter, lp.Sort, lp.Limit, lp.Distinct)):
+        return _output_names(p.child, catalog)
+    if isinstance(p, lp.SubqueryAlias):
+        if p.column_aliases:
+            return list(p.column_aliases)
+        return _output_names(p.child, catalog)
+    if isinstance(p, lp.SetOp):
+        return _output_names(p.left, catalog)
+    if isinstance(p, lp.Join):
+        left = _output_names(p.left, catalog)
+        if p.kind in ("semi", "anti", "nullaware_anti"):
+            return left
+        if p.mark is not None:
+            return None if left is None else left + [p.mark]
+        right = _output_names(p.right, catalog)
+        if left is None or right is None:
+            return None
+        return left + right
+    return None
 
 
 def _graft(top: lp.Plan, old: lp.Plan, new: lp.Plan) -> lp.Plan:

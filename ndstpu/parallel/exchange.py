@@ -32,6 +32,18 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> 31)
 
 
+def mix64_np(x):
+    """Host-side splitmix64, bit-identical to :func:`_mix64` (numpy).
+    Used to pre-partition a join build side with the same bucket
+    assignment the traced probe shuffle will compute."""
+    import numpy as np
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
 def hash_repartition(cols: Dict[str, jnp.ndarray], key: jnp.ndarray,
                      alive: jnp.ndarray, n_dev: int, bucket_cap: int,
                      axis: str = SHARD_AXIS
@@ -47,9 +59,23 @@ def hash_repartition(cols: Dict[str, jnp.ndarray], key: jnp.ndarray,
     (zero drops); smaller caps trade memory for a skew-overflow risk
     the caller MUST check via the returned drop count.
     """
-    n = key.shape[0]
     dest = (_mix64(key) % jnp.uint64(n_dev)).astype(jnp.int32)
-    dest = jnp.where(alive, dest, n_dev)  # dead rows -> dropped bucket
+    return repartition_by_dest(cols, dest, alive, n_dev, bucket_cap, axis)
+
+
+def repartition_by_dest(cols: Dict[str, jnp.ndarray], dest: jnp.ndarray,
+                        alive: jnp.ndarray, n_dev: int, bucket_cap: int,
+                        axis: str = SHARD_AXIS
+                        ) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray,
+                                   jnp.ndarray]:
+    """Shuffle local rows to explicit destination devices.
+
+    ``dest`` is a per-row device index (meaningful where ``alive``);
+    dead rows are dropped in transit.  Same contract as
+    :func:`hash_repartition` otherwise.
+    """
+    n = dest.shape[0]
+    dest = jnp.where(alive, dest.astype(jnp.int32), n_dev)
     order = jnp.argsort(dest, stable=True)
     dsort = dest[order]
     # rank within destination bucket

@@ -1,0 +1,93 @@
+"""Distributed-coverage probe: which corpus query parts run under the
+tpu-spmd executor, and why the rest fall back.
+
+Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+            python scripts/spmd_coverage.py [warehouse_dir]
+
+Renders every template part, plans it, and attempts the distributed
+executor with a tiny shard threshold; prints a per-part verdict and a
+histogram of DistUnsupported reasons.  Guides which dplan gaps matter.
+"""
+
+import collections
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# jax may already be imported (axon sitecustomize): switch the platform
+# via config before any backend initializes, like tests/conftest.py
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.parallel import dplan, mesh as pmesh
+    from ndstpu.queries import streamgen
+
+    if len(sys.argv) > 1:
+        wh = sys.argv[1]
+    else:
+        tmp = tempfile.mkdtemp(prefix="spmdcov")
+        data = os.path.join(tmp, "raw")
+        wh = os.path.join(tmp, "wh")
+        env = dict(os.environ, PYTHONPATH=os.getcwd())
+        subprocess.run(["python", "-m", "ndstpu.datagen.driver", "local",
+                        "0.002", "2", data], check=True, env=env)
+        subprocess.run(["python", "-m", "ndstpu.io.transcode",
+                        "--input_prefix", data, "--output_prefix", wh,
+                        "--report_file", os.path.join(wh, "load.txt")],
+                       check=True, env=env, stdout=subprocess.DEVNULL)
+
+    catalog = loader.load_catalog(wh)
+    mesh = pmesh.make_mesh(8)
+    sess = Session(catalog, backend="cpu")
+
+    reasons = collections.Counter()
+    ok, fell = [], []
+    for tpl in streamgen.list_templates():
+        for name, sql in streamgen.render_template_parts(
+                str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+            try:
+                plan, _ = sess.plan(sql)
+            except Exception as e:  # planner issue, not a dist gap
+                reasons[f"PLAN: {e}"] += 1
+                fell.append((name, f"PLAN: {e}"))
+                continue
+            try:
+                dplan.execute_distributed(catalog, mesh, plan,
+                                          shard_threshold_rows=500)
+                ok.append(name)
+                print(f"  OK   {name}", flush=True)
+            except dplan.DistUnsupported as e:
+                reasons[str(e)] += 1
+                fell.append((name, str(e)))
+                print(f"  FALL {name}: {e}", flush=True)
+            except Exception as e:
+                reasons[f"ERROR {type(e).__name__}: {e}"] += 1
+                fell.append((name, f"ERROR {type(e).__name__}: {e}"))
+                print(f"  ERR  {name}: {type(e).__name__}: {e}", flush=True)
+
+    total = len(ok) + len(fell)
+    print(f"\n== {len(ok)}/{total} parts distributed ==")
+    for reason, cnt in reasons.most_common():
+        print(f"{cnt:4d}  {reason}")
+    print("\nfallback parts:")
+    for name, reason in fell:
+        print(f"  {name}: {reason}")
+
+
+if __name__ == "__main__":
+    main()

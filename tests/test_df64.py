@@ -1,0 +1,75 @@
+"""Compensated float accumulation (ndstpu.engine.df64).
+
+TPU computes float64 at f32 precision; these tests run on CPU where the
+f32 ops behave identically, so the drift comparison below is an honest
+simulation of the on-chip behavior (docs/STATUS.md gap 1)."""
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ndstpu.engine import df64
+
+
+def test_two_sum_exact():
+    a = jnp.float32(1e8)
+    b = jnp.float32(1.5)
+    s, e = df64.two_sum(a, b)
+    # s + e must carry the exact sum the f32 add dropped
+    assert float(s) + float(e) == 1e8 + 1.5
+
+
+def test_segment_sum_matches_fsum():
+    rng = np.random.RandomState(3)
+    n, nseg = 4096, 37
+    gid = np.sort(rng.randint(0, nseg, n)).astype(np.int64)
+    x = rng.uniform(-1e6, 1e6, n)
+    hi, lo = df64.segment_sum_ds(jnp.asarray(x), jnp.asarray(gid), nseg)
+    got = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    for s in range(nseg):
+        want = math.fsum(x[gid == s])
+        assert abs(got[s] - want) <= 2e-8 * max(1.0, abs(want)) + 1e-3, \
+            (s, got[s], want)
+
+
+def test_compensated_beats_naive_f32_drift():
+    """Adversarial accumulation: many small values riding on a large
+    one.  Naive f32 accumulation loses them entirely; the double-single
+    pair keeps ~48 bits."""
+    n = 100_000
+    x = np.full(n, 0.001, np.float64)
+    x[0] = 1e8
+    want = math.fsum(np.float64(np.float32(x)))  # f32-quantized inputs
+    gid = np.zeros(n, np.int64)
+    hi, lo = df64.segment_sum_ds(jnp.asarray(x), jnp.asarray(gid), 1)
+    got = float(np.asarray(hi, np.float64)[0] +
+                np.asarray(lo, np.float64)[0])
+    # sequential f32 accumulation (what a naive running sum does on
+    # chip) absorbs every 0.001 into 1e8 and loses the whole stream
+    naive = float(np.add.accumulate(np.float32(x))[-1])
+    assert abs(naive - want) > 50.0
+    assert abs(got - want) < 1.0            # pair keeps it
+
+
+def test_segment_sum_empty_and_single():
+    z_hi, z_lo = df64.segment_sum_ds(jnp.zeros(0), jnp.zeros(0, jnp.int64), 4)
+    assert np.allclose(np.asarray(z_hi), 0)
+    hi, lo = df64.segment_sum_ds(jnp.asarray([2.5]),
+                                 jnp.asarray([2], dtype=jnp.int64), 4)
+    out = np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+    assert out[2] == 2.5 and out[0] == 0
+
+
+def test_compensated_segment_sum_wrapper():
+    rng = np.random.RandomState(9)
+    n, nseg = 512, 5
+    gid = rng.randint(0, nseg, n).astype(np.int64)
+    x = rng.uniform(-100, 100, n)
+    order = np.argsort(gid, kind="stable")
+    got = np.asarray(df64.segment_sum_compensated(
+        jnp.asarray(x), jnp.asarray(gid), nseg, jnp.asarray(order)))
+    for s in range(nseg):
+        want = math.fsum(x[gid == s])
+        assert abs(got[s] - want) <= 1e-4, (s, got[s], want)

@@ -110,7 +110,8 @@ def dist_catalog(tmp_path_factory):
     return loader.load_catalog(str(wh))
 
 
-def _dist_vs_cpu(catalog, mesh, sql, threshold=1000):
+def _dist_vs_cpu(catalog, mesh, sql, threshold=1000, broadcast_limit=None,
+                 expect_shuffle=0):
     """Plan once; run distributed and on the numpy interpreter; compare."""
     from ndstpu.engine import physical
     from ndstpu.engine.session import Session
@@ -119,8 +120,16 @@ def _dist_vs_cpu(catalog, mesh, sql, threshold=1000):
     sess = Session(catalog, backend="cpu")
     plan, _cols = sess.plan(sql)
     want = physical.execute(plan, catalog)
-    got = dplan.execute_distributed(catalog, mesh, plan,
-                                    shard_threshold_rows=threshold)
+    kw = {}
+    if broadcast_limit is not None:
+        kw["broadcast_limit_rows"] = broadcast_limit
+    exe = dplan.DistributedPlanExecutor(catalog, mesh,
+                                        shard_threshold_rows=threshold, **kw)
+    got = exe.execute_plan(plan)
+    n_shuffle = sum(1 for j in exe.joins.values()
+                    if isinstance(j, dplan._ShuffleJoin))
+    assert n_shuffle >= expect_shuffle, \
+        f"expected >= {expect_shuffle} shuffle joins, traced {n_shuffle}"
     assert want.column_names == got.column_names
     rows_w = sorted(want.to_rows(), key=lambda r: tuple(
         (v is None, str(v)) for v in r))
@@ -231,15 +240,182 @@ def test_session_spmd_backend(dist_catalog):
     assert not spmd._spmd_used
 
 
+def test_dist_shuffle_join_inner(dist_catalog, mesh8):
+    # fact-fact join over the broadcast limit: all_to_all hash exchange
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select count(*) as c, sum(ss_quantity) as q "
+                 "from store_sales, store_returns "
+                 "where ss_item_sk = sr_item_sk "
+                 "and ss_ticket_number = sr_ticket_number",
+                 broadcast_limit=50, expect_shuffle=1)
+
+
+def test_dist_shuffle_join_left_groupby(dist_catalog, mesh8):
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select i_item_id, count(sr_ticket_number) as r, "
+                 "sum(ss_ext_sales_price) as s "
+                 "from store_sales left join store_returns "
+                 "on ss_item_sk = sr_item_sk "
+                 "and ss_ticket_number = sr_ticket_number "
+                 "join item on ss_item_sk = i_item_sk "
+                 "group by i_item_id",
+                 broadcast_limit=50, expect_shuffle=2)
+
+
+def test_dist_shuffle_join_semi_rowmode(dist_catalog, mesh8):
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select count(*) as c from store_sales where exists "
+                 "(select 1 from store_returns where sr_item_sk = ss_item_sk "
+                 "and sr_ticket_number = ss_ticket_number)",
+                 broadcast_limit=50, expect_shuffle=1)
+    # row-mode spine: joined rows come back sharded, no aggregate
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_item_sk, ss_ticket_number, sr_return_quantity "
+                 "from store_sales, store_returns "
+                 "where ss_item_sk = sr_item_sk "
+                 "and ss_ticket_number = sr_ticket_number",
+                 broadcast_limit=50, expect_shuffle=1)
+
+
+def test_dist_shuffle_skew_retry(dist_catalog, mesh8):
+    """A low-cardinality shuffle key (every probe row hashes to a handful
+    of buckets) overflows the first receive-bucket size; the executor
+    must retry with doubled slack up to the lossless bound, never drop."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    sql = ("select s_store_id, count(*) as n from store_sales, store "
+           "where ss_store_sk = s_store_sk group by s_store_id")
+    plan, _ = sess.plan(sql)
+    want = physical.execute(plan, dist_catalog)
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=1000,
+                                        broadcast_limit_rows=0)
+    got = exe.execute_plan(plan)
+    assert exe.shuffle_slack > 2, "skew did not trigger a slack retry"
+    assert exe._last_dropped == 0
+    assert sorted(map(str, want.to_rows())) == sorted(map(str, got.to_rows()))
+
+
+def test_dist_empty_build_side(dist_catalog, mesh8):
+    # a dimension filter that matches nothing: the broadcast build side
+    # is empty — joins must produce typed NULLs / empty results, not
+    # crash in a zero-row gather
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select count(*) as n, sum(ss_net_paid) as s "
+                 "from store_sales, date_dim where ss_sold_date_sk = "
+                 "d_date_sk and d_year = 1800")
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_item_sk, d_year from store_sales left join "
+                 "date_dim on ss_sold_date_sk = d_date_sk and d_year = 1800")
+
+
+def test_dist_deep_aggregate_split(dist_catalog, mesh8):
+    # stacked aggregates: the DEEPEST one is the spine top; the outer
+    # aggregate and sort run in the host tail over the small result
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select avg(s) as a from (select ss_store_sk, "
+                 "sum(ss_net_paid) as s from store_sales "
+                 "group by ss_store_sk) t")
+
+
+def test_dist_rollup_grouping_sets(dist_catalog, mesh8):
+    # ROLLUP runs the spine at the finest grouping; each set re-combines
+    # the decomposable partials on the host (q18/q22/q27/q36/q70 shape)
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select i_category, i_class, "
+                 "grouping(i_category) + grouping(i_class) as lochierarchy, "
+                 "sum(ss_net_profit) as p, avg(ss_quantity) as aq, "
+                 "count(*) as n, min(ss_sales_price) as lo, "
+                 "max(ss_sales_price) as hi "
+                 "from store_sales, item where ss_item_sk = i_item_sk "
+                 "group by rollup(i_category, i_class)")
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select d_year, stddev_samp(ss_quantity) as sd "
+                 "from store_sales, date_dim "
+                 "where ss_sold_date_sk = d_date_sk "
+                 "group by rollup(d_year)")
+
+
+def test_dist_distinct_aggregates(dist_catalog, mesh8):
+    # DISTINCT colocates each group's rows on one device (all_to_all by
+    # group-key hash), then dedups locally — globally exact
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_store_sk, count(distinct ss_ticket_number) "
+                 "as t, count(*) as n, sum(ss_quantity) as q "
+                 "from store_sales group by ss_store_sk")
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select d_year, count(distinct ss_customer_sk) as c, "
+                 "sum(distinct ss_sales_price) as sd "
+                 "from store_sales, date_dim "
+                 "where ss_sold_date_sk = d_date_sk group by d_year")
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select count(distinct ss_item_sk) as u from store_sales")
+
+
+def test_dist_union_all_aggregate(dist_catalog, mesh8):
+    """Channel-union aggregates (q5/q33/q56/q60/q66/q71/q76 shape): each
+    branch runs as its own sharded spine; the host combines decomposable
+    partials across branches."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    queries = [
+        # union -> group by
+        "select item_sk, sum(amt) as total, count(*) as n from ("
+        "select ss_item_sk as item_sk, ss_ext_sales_price as amt "
+        "from store_sales union all "
+        "select cs_item_sk as item_sk, cs_ext_sales_price as amt "
+        "from catalog_sales union all "
+        "select ws_item_sk as item_sk, ws_ext_sales_price as amt "
+        "from web_sales) t group by item_sk",
+        # union -> rollup (q5 shape)
+        "select chan, sk, sum(amt) as total from ("
+        "select 'store' as chan, ss_store_sk as sk, ss_net_profit as amt "
+        "from store_sales union all "
+        "select 'web' as chan, ws_web_site_sk as sk, ws_net_profit as amt "
+        "from web_sales) t group by rollup(chan, sk)",
+        # union -> global aggregate; min/max fold across branches
+        "select sum(amt) as total, min(amt) as lo, max(amt) as hi from ("
+        "select ss_ext_sales_price as amt from store_sales union all "
+        "select ws_ext_sales_price as amt from web_sales) t",
+        # min/max over per-branch dictionary-encoded strings must
+        # translate into the union dictionary before folding
+        "select k, min(id) as lo, max(id) as hi from ("
+        "select ss_store_sk as k, i_item_id as id from store_sales, item "
+        "where ss_item_sk = i_item_sk union all "
+        "select cs_call_center_sk as k, i_item_id as id from "
+        "catalog_sales, item where cs_item_sk = i_item_sk) t group by k",
+    ]
+    for sql in queries:
+        plan, _ = sess.plan(sql)
+        want = physical.execute(plan, dist_catalog)
+        exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                            shard_threshold_rows=500)
+        got = exe.execute_plan(plan)
+        assert exe._union_ctx is not None, f"union path not taken: {sql}"
+        assert any(e is not None for e in exe._union_ctx[2])
+        rw = sorted(map(str, want.to_rows()))
+        rg = sorted(map(str, got.to_rows()))
+        assert want.column_names == got.column_names
+        assert rw == rg
+        # cached repeat execution
+        assert sorted(map(str, exe.execute_again().to_rows())) == rg
+
+
 def test_dist_unsupported_falls_out(dist_catalog, mesh8):
     from ndstpu.engine.session import Session
     from ndstpu.parallel import dplan
 
     sess = Session(dist_catalog, backend="cpu")
-    # fact-fact join: the second table exceeds the broadcast limit
+    # full outer join is outside the spine subset
     plan, _ = sess.plan(
-        "select count(*) as n from store_sales, store_returns "
-        "where ss_ticket_number = sr_ticket_number "
+        "select count(*) as n from store_sales full join store_returns "
+        "on ss_ticket_number = sr_ticket_number "
         "and ss_item_sk = sr_item_sk")
     with pytest.raises(dplan.DistUnsupported):
         dplan.execute_distributed(dist_catalog, mesh8, plan,
