@@ -1183,9 +1183,6 @@ class JaxExecutor:
         if key_cols:
             keys = [_key_i64(c, dt.alive) for _, c in key_cols]
             gid, order, newgrp = _group_ids(keys)
-            # gid-sorted row order: float sums ride the compensated
-            # segmented scan (ndstpu.engine.df64) instead of f32-drift
-            self._agg_order = order
             ngseg = cap
             # representative (first-in-sorted-order) row per group
             first_pos = jnp.full(cap, cap, jnp.int64).at[
@@ -1202,13 +1199,18 @@ class JaxExecutor:
                                       c.ctype, c.dictionary)
         else:
             gid = jnp.where(dt.alive, 0, 1).astype(jnp.int64)
-            self._agg_order = jnp.argsort(gid, stable=True)
+            order = jnp.argsort(gid, stable=True)
             ngseg = cap
             out_alive = jnp.zeros(cap, bool).at[0].set(True)
             out_cols = {}
+        # gid-sorted row order rides alongside gid: float sums use the
+        # compensated segmented scan (ndstpu.engine.df64).  Passed as a
+        # parameter, NOT instance state — _resolve_subqueries may run a
+        # nested aggregate mid-loop and would clobber it.
         for name, e in p.aggs:
             out_cols[name] = self._eval_agg(
-                dt, evl, self._resolve_subqueries(e), gid, ngseg, out_alive)
+                dt, evl, self._resolve_subqueries(e), gid, ngseg, out_alive,
+                order)
         return DTable(out_cols, out_alive)
 
     def _check_agg_supported(self, e: ex.Expr):
@@ -1224,9 +1226,10 @@ class JaxExecutor:
                     raise Unsupported(f"aggregate {node.func}")
 
     def _eval_agg(self, dt: DTable, evl: JEval, e: ex.Expr, gid, ngseg,
-                  out_alive) -> DCol:
+                  out_alive, order) -> DCol:
         if isinstance(e, ex.AggExpr):
-            return self._agg_column(dt, evl, e, gid, ngseg, out_alive)
+            return self._agg_column(dt, evl, e, gid, ngseg, out_alive,
+                                    order)
         if isinstance(e, ex.Func) and e.name == "grouping":
             # grouping(key) = 0 when the key participates in this grouping
             # set, 1 when rolled up (Spark semantics)
@@ -1248,13 +1251,13 @@ class JaxExecutor:
                     name = f"__agg{counter[0]}"
                     counter[0] += 1
                     sub_cols[name] = self._agg_column(
-                        dt, evl, node, gid, ngseg, out_alive)
+                        dt, evl, node, gid, ngseg, out_alive, order)
                     return ex.ColumnRef(name)
                 if isinstance(node, ex.Func) and node.name == "grouping":
                     name = f"__agg{counter[0]}"
                     counter[0] += 1
                     sub_cols[name] = self._eval_agg(
-                        dt, evl, node, gid, ngseg, out_alive)
+                        dt, evl, node, gid, ngseg, out_alive, order)
                     return ex.ColumnRef(name)
                 if isinstance(node, ex.BinOp):
                     return ex.BinOp(node.op, lower(node.left),
@@ -1278,18 +1281,18 @@ class JaxExecutor:
             return JEval(gtable).eval(lowered)
         raise Unsupported(f"aggregate output {type(e).__name__}")
 
-    def _segment_sum_typed(self, vals, gid, ngseg, kind: str):
+    @staticmethod
+    def _segment_sum_typed(vals, gid, ngseg, kind: str, order):
         """int/decimal sums stay exact s64 segment_sum; float sums use
         the compensated segmented scan (TPU computes f64 at f32
         precision — ndstpu.engine.df64)."""
         if kind in ("decimal", "int32", "int64"):
             return jax.ops.segment_sum(vals, gid, num_segments=ngseg)
         from ndstpu.engine import df64
-        return df64.segment_sum_compensated(vals, gid, ngseg,
-                                            self._agg_order)
+        return df64.segment_sum_compensated(vals, gid, ngseg, order)
 
     def _agg_column(self, dt: DTable, evl: JEval, a: ex.AggExpr, gid, ngseg,
-                    out_alive) -> DCol:
+                    out_alive, order) -> DCol:
         func = a.func
         alive = dt.alive
         if a.distinct and func in ("count", "sum", "avg") and \
@@ -1312,7 +1315,7 @@ class JaxExecutor:
         if func == "sum":
             sums = self._segment_sum_typed(
                 _sum_input(c.data, valid, c.ctype.kind), gid, ngseg,
-                c.ctype.kind)
+                c.ctype.kind, order)
             if c.ctype.kind == "decimal":
                 return DCol(sums, got, decimal(38, c.ctype.scale))
             if c.ctype.kind in ("int32", "int64"):
@@ -1323,7 +1326,7 @@ class JaxExecutor:
                                        num_segments=ngseg)
             sums = self._segment_sum_typed(
                 _sum_input(c.data, valid, c.ctype.kind), gid, ngseg,
-                c.ctype.kind)
+                c.ctype.kind, order)
             data = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
             if c.ctype.kind == "decimal":
                 data = data / (10 ** c.ctype.scale)
@@ -1346,8 +1349,9 @@ class JaxExecutor:
         if func in ("stddev_samp", "var_samp", "stddev", "variance"):
             x = evl.cast(c, FLOAT64).data
             xv = jnp.where(valid, x, 0.0)
-            s1 = self._segment_sum_typed(xv, gid, ngseg, "float64")
-            s2 = self._segment_sum_typed(xv * xv, gid, ngseg, "float64")
+            s1 = self._segment_sum_typed(xv, gid, ngseg, "float64", order)
+            s2 = self._segment_sum_typed(xv * xv, gid, ngseg, "float64",
+                                         order)
             cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                       num_segments=ngseg)
             ok = cnt > 1

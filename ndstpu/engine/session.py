@@ -28,6 +28,9 @@ class Session:
     backend: str = "cpu"
     # tpu-spmd: minimum table rows to shard (None = dplan default)
     spmd_threshold: Optional[int] = None
+    # tpu-spmd: stream facts larger than this through the device in
+    # chunks (out-of-core scan); None = whole-fact HBM-resident
+    spmd_chunk_rows: Optional[int] = None
     # bumped on view create/drop — part of the compiled-query cache key
     # (same SQL text over a redefined view must not reuse a stale plan)
     _views_epoch: int = 0
@@ -133,6 +136,8 @@ class Session:
                 kw = {"dev_cache": self._spmd_dev_cache}
                 if self.spmd_threshold is not None:
                     kw["shard_threshold_rows"] = self.spmd_threshold
+                if self.spmd_chunk_rows is not None:
+                    kw["chunk_rows"] = self.spmd_chunk_rows
                 exe = dplan.DistributedPlanExecutor(
                     self.catalog, self._mesh(), **kw)
                 out = exe.execute_plan(plan)

@@ -188,7 +188,12 @@ def run_full_bench(yaml_params: dict) -> None:
     for fs in (1, 2):
         if not t.get("skip"):
             ids = ",".join(str(x) for x in get_stream_range(num_streams, fs))
-            run(PY + ["ndstpu.harness.throughput", ids, "--"] +
+            tcmd = PY + ["ndstpu.harness.throughput", ids]
+            if t.get("concurrent"):
+                # device admission: at most N streams on the chip at a
+                # time (the concurrentGpuTasks analog)
+                tcmd += ["--concurrent", str(t["concurrent"])]
+            run(tcmd + ["--"] +
                 PY + ["ndstpu.harness.power",
                       os.path.join(g["stream_output_path"], "query_{}.sql"),
                       l["warehouse_path"],

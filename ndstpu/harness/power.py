@@ -170,13 +170,25 @@ def run_query_stream(args) -> None:
         query_dict = get_query_subset(query_dict,
                                       args.sub_queries.split(","))
 
+    # concurrent-stream admission: at most N streams execute on the
+    # device at once (the concurrentGpuTasks analog; set by the
+    # throughput runner via env, see ndstpu.harness.admission)
+    from ndstpu.harness import admission as adm
+    gate = adm.from_env()
+
     power_start = int(time.time())
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(engine_conf)
-        summary = q_report.report_on(run_one_query, sess, q_content,
-                                     query_name, args.output_prefix,
-                                     args.output_format)
+        if gate is not None:
+            gate.acquire()
+        try:
+            summary = q_report.report_on(run_one_query, sess, q_content,
+                                         query_name, args.output_prefix,
+                                         args.output_format)
+        finally:
+            if gate is not None:
+                gate.release()
         print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
         execution_times.append((app_id, query_name,
                                 summary["queryTimes"][0]))

@@ -4,44 +4,73 @@ The reference fans out concurrent spark-submit processes with
 `xargs -d, -P<n> -I{}` substituting the stream id into the command
 (/root/reference/nds/nds-throughput:18-23).  Here each stream is one OS
 process running the power CLI with `{}` placeholders substituted the same
-way.
+way.  `--concurrent N` bounds how many streams execute on the shared
+device at once (the `spark.rapids.sql.concurrentGpuTasks` analog,
+power_run_gpu.template:21) via a cross-process file-lock semaphore —
+see ndstpu.harness.admission.
 
-    python -m ndstpu.harness.throughput 1,2,3 -- \\
+    python -m ndstpu.harness.throughput 1,2,3 --concurrent 2 -- \\
         python -m ndstpu.harness.power ./query_{}.sql ./wh ./time_{}.csv
 """
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
-from typing import List
+import tempfile
+from typing import List, Optional
 
 
-def run_throughput(stream_ids: List[str], cmd_template: List[str]) -> int:
-    procs = []
-    for sid in stream_ids:
-        cmd = [arg.replace("{}", sid) for arg in cmd_template]
-        print("launch:", " ".join(cmd))
-        procs.append(subprocess.Popen(cmd))
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+def run_throughput(stream_ids: List[str], cmd_template: List[str],
+                   concurrent: Optional[int] = None) -> int:
+    env = None
+    lock_dir = None
+    if concurrent is not None:
+        lock_dir = tempfile.mkdtemp(prefix="ndstpu_adm")
+        env = dict(os.environ,
+                   NDSTPU_ADMISSION_SLOTS=str(concurrent),
+                   NDSTPU_ADMISSION_DIR=lock_dir)
+    try:
+        procs = []
+        for sid in stream_ids:
+            cmd = [arg.replace("{}", sid) for arg in cmd_template]
+            print("launch:", " ".join(cmd))
+            procs.append(subprocess.Popen(cmd, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    finally:
+        if lock_dir is not None:
+            import shutil
+            shutil.rmtree(lock_dir, ignore_errors=True)
 
 
 def main(argv: List[str]) -> int:
-    if "--" in argv:
-        sep = argv.index("--")
-        ids_arg, cmd = argv[:sep], argv[sep + 1:]
+    # --concurrent belongs to the wrapper: parse it only from the part
+    # BEFORE the "--" separator so the wrapped command's flags are safe
+    sep = argv.index("--") if "--" in argv else None
+    head = argv[:sep] if sep is not None else argv
+    concurrent = None
+    if "--concurrent" in head:
+        i = head.index("--concurrent")
+        if i + 1 >= len(head):
+            print("--concurrent requires a value", file=sys.stderr)
+            return 2
+        concurrent = int(head[i + 1])
+        head = head[:i] + head[i + 2:]
+    if sep is not None:
+        ids_arg, cmd = head, argv[sep + 1:]
     else:
-        ids_arg, cmd = argv[:1], argv[1:]
+        ids_arg, cmd = head[:1], head[1:]
     if not ids_arg or not cmd:
-        print("usage: throughput <id,id,...> -- <command with {} "
-              "placeholders>", file=sys.stderr)
+        print("usage: throughput <id,id,...> [--concurrent N] -- "
+              "<command with {} placeholders>", file=sys.stderr)
         return 2
     stream_ids = [s for s in ids_arg[0].split(",") if s]
-    return run_throughput(stream_ids, cmd)
+    return run_throughput(stream_ids, cmd, concurrent)
 
 
 if __name__ == "__main__":

@@ -83,6 +83,10 @@ class _BroadcastJoin:
     spine_left: bool                 # spine side is the join's left child
     build_has_null: bool = False     # any build row with a NULL key part
     build_empty: bool = False
+    # per key part: the build dictionary for string keys (None = numeric)
+    key_dicts: Optional[List[Optional[np.ndarray]]] = None
+    # >0: semi/anti/mark residual probes every duplicate in a key run
+    dup_max: int = 0
 
 
 @dataclasses.dataclass
@@ -115,6 +119,10 @@ class _ShuffleJoin:
     # shard_map argument list
     arg_start: int = -1
     n_args: int = 0
+    # per key part: the build dictionary for string keys (None = numeric)
+    key_dicts: Optional[List[Optional[np.ndarray]]] = None
+    # >0: semi/anti/mark residual probes every duplicate in a key run
+    dup_max: int = 0
 
 
 class DistributedPlanExecutor:
@@ -122,12 +130,17 @@ class DistributedPlanExecutor:
 
     def __init__(self, catalog, mesh, shard_threshold_rows: int = 65536,
                  broadcast_limit_rows: int = 8_000_000,
-                 dev_cache: Optional[dict] = None):
+                 dev_cache: Optional[dict] = None,
+                 chunk_rows: Optional[int] = None):
         self.catalog = catalog
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.threshold = shard_threshold_rows
         self.broadcast_limit = broadcast_limit_rows
+        # out-of-core: facts above this row count stream through the
+        # device in chunks of this size (one compiled program, partials
+        # combined across chunks on the host); None = whole-fact resident
+        self.chunk_rows = chunk_rows
         self.np_exec = physical.Executor(catalog)
         # shared (table, column, version) -> device arrays cache so many
         # cached query executors don't pin duplicate fact copies in HBM
@@ -240,6 +253,8 @@ class DistributedPlanExecutor:
         tpu-spmd queries (no re-trace, no re-compile, no host build)."""
         if self._union_ctx is not None:
             return self._union_again()
+        if getattr(self, "_chunk_info", (False,))[0]:
+            return self._finish(self._run_chunks())
         out = jax.device_get(self._compiled_fn(*self._dev_args))
         return self._finish(self._post_spine(out))
 
@@ -247,21 +262,14 @@ class DistributedPlanExecutor:
 
     def _try_union_agg(self, plan: lp.Plan) -> Optional[Table]:
         """Distribute an Aggregate over a UNION ALL of channel subplans
-        (q5/q33/q56/q60/q66/q71/q76... shape): run each branch as its own
-        sharded spine collecting finest-group partials, then combine the
-        decomposable partials across branches on the host.  Returns None
-        when the plan doesn't match or no branch can be distributed."""
-        found = None
-        for agg in (n for n in plan.walk()
-                    if isinstance(n, lp.Aggregate)):
-            node = agg.child
-            while isinstance(node, (lp.Project, lp.Filter,
-                                    lp.SubqueryAlias)):
-                node = node.child
-            if isinstance(node, lp.SetOp) and node.kind == "union" \
-                    and node.all:
-                found = (agg, node)
-                break
+        (q2/q5/q33/q56/q60/q66/q71/q76... shape): run each branch as its
+        own sharded spine (the union may sit under joins/projects inside
+        the aggregate), collect finest-group partials, and combine the
+        decomposable partials across branches on the host.  The plan
+        remainder (outer rollups, second union sites from reused CTEs)
+        recurses into a fresh executor so EVERY union site distributes.
+        Returns None when no site matches or no branch distributes."""
+        found = self._find_union_site(plan)
         if found is None:
             return None
         agg, setop = found
@@ -269,6 +277,45 @@ class DistributedPlanExecutor:
             self._check_agg(agg)
         except DistUnsupported:
             return None
+        return self._run_union_site(plan, agg, setop)
+
+    def _find_union_site(self, plan: lp.Plan):
+        """Deepest Aggregate that directly dominates (no intervening
+        aggregate) a union-all SetOp; among its unions, the one holding
+        the largest base table."""
+
+        def walk_depth(p, d=0):
+            yield p, d
+            for c in p.children():
+                yield from walk_depth(c, d + 1)
+
+        def union_size(s: lp.SetOp) -> int:
+            rows = [self.catalog.get(n.table).num_rows
+                    for n in s.walk() if isinstance(n, lp.Scan)]
+            return max(rows, default=0)
+
+        best = None
+        for node, depth in walk_depth(plan):
+            if not isinstance(node, lp.Aggregate):
+                continue
+            direct = [s for s in node.child.walk()
+                      if isinstance(s, lp.SetOp) and s.kind == "union"
+                      and s.all and _distributive_path(node.child, s)]
+            if not direct:
+                continue
+            # outermost first: nested unions inside a branch are
+            # flattened into extra branches by _expand_branches
+            s = min(direct,
+                    key=lambda s: (len(_path_to(node.child, s) or ()),
+                                   -union_size(s)))
+            if union_size(s) < self.threshold:
+                continue
+            if best is None or depth > best[0]:
+                best = (depth, node, s)
+        return (best[1], best[2]) if best is not None else None
+
+    def _run_union_site(self, plan: lp.Plan, agg: lp.Aggregate,
+                        setop: lp.SetOp) -> Optional[Table]:
         leaves = self._agg_leaves(agg)
         if any(a.distinct for a in leaves):
             return None    # cross-branch dedup not supported
@@ -283,11 +330,11 @@ class DistributedPlanExecutor:
                     branches.append(side)
 
         flat(setop)
+        branches = self._expand_branches(branches)
         left_names = _output_names(branches[0], self.catalog)
         if left_names is None:
             return None
         sub_execs: List[Optional[DistributedPlanExecutor]] = []
-        host_plans: List[Optional[lp.Aggregate]] = []
         parts: List[tuple] = []   # (key_cols, leaf_parts, leaf_meta)
         any_dist = False
         for i, b in enumerate(branches):
@@ -310,7 +357,6 @@ class DistributedPlanExecutor:
                 kc, lps = exe.collect_partials(bplan)
                 parts.append((kc, lps, list(exe._leaf_meta)))
                 sub_execs.append(exe)
-                host_plans.append(None)
                 any_dist = True
             except DistUnsupported:
                 try:
@@ -319,15 +365,64 @@ class DistributedPlanExecutor:
                     return None    # falls back to the non-union paths
                 parts.append((kc, lps, meta))
                 sub_execs.append(None)
-                host_plans.append(bplan)
         if not any_dist:
             return None
         result = self._finalize_union(agg, leaves, parts)
         self._union_ctx = (plan, agg, sub_execs, parts, leaves)
         if agg is plan:
+            self._union_rest = None
+            self._union_next = None
             return result
-        return self.np_exec.execute(_graft(
-            plan, agg, lp.InlineTable(result, "__dist_union__")))
+        # recurse on the remainder so further union sites (other
+        # channels, a CTE's second instantiation) distribute too; the
+        # recursion bottoms out in the single-spine path or numpy
+        rest = _graft(plan, agg, lp.InlineTable(result, "__dist_union__"))
+        self._union_rest = rest
+        nxt = DistributedPlanExecutor(
+            self.catalog, self.mesh, self.threshold,
+            self.broadcast_limit, self.dev_cache)
+        try:
+            out = nxt.execute_plan(rest)
+            self._union_next = nxt
+            return out
+        except DistUnsupported:
+            self._union_next = None
+            return self.np_exec.execute(rest)
+
+    @staticmethod
+    def _expand_branches(branches: List[lp.Plan],
+                         cap: int = 16) -> List[lp.Plan]:
+        """Flatten unions NESTED inside branches into extra top-level
+        branches while the path to them distributes over UNION ALL
+        (q5 shape: each channel joins dims onto an inner sales∪returns
+        union).  Branches beyond `cap` stay unexpanded (host fallback)."""
+        work = list(branches)
+        out: List[lp.Plan] = []
+        while work:
+            b = work.pop(0)
+            inner = next(
+                (s for s in b.walk()
+                 if isinstance(s, lp.SetOp) and s.kind == "union"
+                 and s.all and _distributive_path(b, s)), None)
+            if inner is None:
+                out.append(b)
+                continue
+            sides: List[lp.Plan] = []
+
+            def flat(s: lp.SetOp) -> None:
+                for side in (s.left, s.right):
+                    if isinstance(side, lp.SetOp) and \
+                            side.kind == "union" and side.all:
+                        flat(side)
+                    else:
+                        sides.append(side)
+
+            flat(inner)
+            if len(out) + len(work) + len(sides) > cap:
+                out.append(b)
+                continue
+            work = [_graft(b, inner, s) for s in sides] + work
+        return out
 
     def _union_again(self) -> Table:
         plan, agg, sub_execs, first_parts, leaves = self._union_ctx
@@ -345,8 +440,12 @@ class DistributedPlanExecutor:
         result = self._finalize_union(agg, leaves, parts)
         if agg is plan:
             return result
-        return self.np_exec.execute(_graft(
-            plan, agg, lp.InlineTable(result, "__dist_union__")))
+        # versions unchanged => identical union result; the remainder
+        # plan staged at first execution (with that result inlined) is
+        # still valid, so replay it
+        if self._union_next is not None:
+            return self._union_next.execute_again()
+        return self.np_exec.execute(self._union_rest)
 
     def _host_partials(self, bplan: lp.Aggregate):
         """Numpy finest-group partials for one union branch that can't
@@ -438,8 +537,20 @@ class DistributedPlanExecutor:
         for li, a in enumerate(leaves):
             bmetas = [m[li] for _, _, m in parts]
             func, ct0, _ = bmetas[0]
+
+            def compatible(ct2) -> bool:
+                # partials combine on kind + decimal scale; precision
+                # widening (e.g. `0 - x`) doesn't change the encoding
+                if ct0 is None or ct2 is None:
+                    return ct0 is ct2
+                ints = ("int32", "int64")
+                if ct2.kind != ct0.kind and not (
+                        ct2.kind in ints and ct0.kind in ints):
+                    return False
+                return ct0.kind != "decimal" or ct2.scale == ct0.scale
+
             for f2, ct2, _ in bmetas[1:]:
-                if f2 != func or ct2 != ct0:
+                if f2 != func or not compatible(ct2):
                     raise DistUnsupported(
                         "union branches disagree on aggregate type")
             dicts = [m[li][2] for _, _, m in parts]
@@ -602,20 +713,39 @@ class DistributedPlanExecutor:
             probe_exprs = [l for l, _ in keys]
             bvalid = np.ones(build.num_rows, dtype=bool)
             key_parts = []
+            key_dicts: List[Optional[np.ndarray]] = []
+            fixed_spans: List[Optional[Tuple[int, int]]] = []
             for _, be in keys:
                 c = ex.Evaluator(build).eval(be)
-                if c.ctype.kind not in _KEY_KINDS:
+                if c.ctype.kind == "string":
+                    # string keys join in the BUILD dictionary's code
+                    # space; the traced probe translates its own codes
+                    # through a static mapping (both dictionaries are
+                    # host metadata at trace time)
+                    if c.dictionary is None:
+                        raise DistUnsupported(
+                            "string join key without dictionary")
+                    key_parts.append(c.data.astype(np.int64))
+                    key_dicts.append(c.dictionary)
+                    fixed_spans.append((0, len(c.dictionary) + 1))
+                elif c.ctype.kind in _KEY_KINDS:
+                    key_parts.append(c.data.astype(np.int64))
+                    key_dicts.append(None)
+                    fixed_spans.append(None)
+                else:
                     raise DistUnsupported(
                         f"{c.ctype.kind} join key on spine")
-                key_parts.append(c.data.astype(np.int64))
                 bvalid &= c.validity()
             bkeys = np.zeros(build.num_rows, dtype=np.int64)
             radices: List[Tuple[int, int]] = []
             bound = 1
-            for part in key_parts:
-                lo = int(part.min()) if len(part) else 0
-                hi = int(part.max()) if len(part) else 0
-                span = hi - lo + 2
+            for part, fixed in zip(key_parts, fixed_spans):
+                if fixed is not None:
+                    lo, span = fixed
+                else:
+                    lo = int(part.min()) if len(part) else 0
+                    hi = int(part.max()) if len(part) else 0
+                    span = hi - lo + 2
                 bound *= span
                 if bound >= 2 ** 62:
                     raise DistUnsupported("composite key domain overflow")
@@ -628,29 +758,85 @@ class DistributedPlanExecutor:
             skeys = skeys[first_valid:]
             row_of = order[first_valid:]
             unique = len(np.unique(skeys)) == len(skeys)
-            if not unique and (kind in ("inner", "left") or
-                               p.extra is not None):
-                # semi/anti/mark tolerate duplicate build keys ONLY when
-                # there is no residual: the probe gathers a single
-                # arbitrary duplicate, so a residual would be evaluated
-                # against one of many candidate rows
-                raise DistUnsupported(
-                    f"non-unique build keys for {kind} join")
+            if not unique and kind == "inner" and self._dup_insensitive \
+                    and not (set(build.column_names)
+                             & self._refs_above_join(p, build_plan)):
+                # an expanding inner join none of whose build columns
+                # survive past the join itself, feeding a
+                # duplicate-insensitive aggregate (pure GROUP BY dedup or
+                # min/max/distinct leaves): row multiplicity is
+                # irrelevant, so probe existence suffices — run it as a
+                # semi join (q37/q82 inventory-expansion shape)
+                kind = "semi"
+            dup_max = 0
+            if not unique:
+                if kind in ("inner", "left"):
+                    # probe-side cardinality would expand
+                    raise DistUnsupported(
+                        f"non-unique build keys for {kind} join")
+                if p.extra is not None:
+                    # semi/anti/mark with a residual: probe every
+                    # duplicate in the key run (bounded unrolled loop,
+                    # q16/q94 self-join EXISTS shape)
+                    if kind == "nullaware_anti":
+                        raise DistUnsupported(
+                            "residual on nullaware anti join")
+                    _, counts = np.unique(skeys, return_counts=True)
+                    dup_max = int(counts.max()) if len(counts) else 0
+                    if dup_max > 32:
+                        raise DistUnsupported(
+                            f"build key runs too long ({dup_max})")
             if build.num_rows > self.broadcast_limit:
-                self.joins[id(p)] = self._stage_shuffle_join(
+                sj = self._stage_shuffle_join(
                     p, kind, probe_exprs, radices, skeys, row_of, build,
                     on_left, bool((~bvalid).any()))
+                sj.key_dicts = key_dicts
+                sj.dup_max = dup_max
+                self.joins[id(p)] = sj
             else:
                 self.joins[id(p)] = _BroadcastJoin(
                     kind, p.mark, p.extra, probe_exprs, radices, skeys,
                     row_of, build, on_left,
                     build_has_null=bool((~bvalid).any()),
-                    build_empty=build.num_rows == 0)
+                    build_empty=build.num_rows == 0,
+                    key_dicts=key_dicts, dup_max=dup_max)
             return True
         spine = False
         for c in p.children():
             spine = self._prepare(c) or spine
         return spine
+
+    def _refs_above_join(self, p: lp.Join, build_plan: lp.Plan) -> set:
+        """Column names referenced anywhere on the spine OUTSIDE the
+        given join's build subtree — i.e. the columns that must survive
+        past the join.  The join's own build-side keys and residual are
+        consumed by the join and excluded."""
+        skip = {id(n) for n in build_plan.walk()}
+        refs = set(self._agg_refs)
+
+        def collect(e: ex.Expr) -> None:
+            refs.update(nd.name for nd in e.walk()
+                        if isinstance(nd, ex.ColumnRef))
+
+        for nd in self._row_head.walk():
+            if id(nd) in skip:
+                continue
+            if isinstance(nd, lp.Scan) and nd.predicate is not None:
+                collect(nd.predicate)
+            elif isinstance(nd, lp.Filter):
+                collect(nd.condition)
+            elif isinstance(nd, lp.Project):
+                for _, e in nd.exprs:
+                    collect(e)
+            elif isinstance(nd, lp.Join):
+                if nd is p:
+                    continue   # own keys/extra are consumed here
+                for le, re in nd.keys:
+                    collect(le)
+                    collect(re)
+                if nd.extra is not None:
+                    collect(nd.extra)
+        return refs
 
     def _stage_shuffle_join(self, p: lp.Join, kind: str, probe_exprs,
                             radices, skeys: np.ndarray, row_of: np.ndarray,
@@ -702,6 +888,20 @@ class DistributedPlanExecutor:
                         if isinstance(sub, ex.SubqueryExpr):
                             raise DistUnsupported(
                                 "subquery above row spine")
+            # duplicate row multiplicity is invisible to the spine's
+            # aggregate when every leaf is min/max or DISTINCT (or the
+            # aggregate is a pure GROUP BY dedup) — _prepare may then
+            # demote expanding inner joins to semi joins
+            self._dup_insensitive = agg is not None and all(
+                a.func in ("min", "max") or a.distinct
+                for a in self._agg_leaves(agg))
+            self._row_head = row_head
+            self._agg_refs = set()
+            if agg is not None:
+                for _, e in agg.aggs + agg.group_by:
+                    self._agg_refs |= {
+                        nd.name for nd in e.walk()
+                        if isinstance(nd, ex.ColumnRef)}
             self._prepare(row_head)
             self._prepared = True
         if self.fact is None:
@@ -714,39 +914,68 @@ class DistributedPlanExecutor:
         if not names:
             names = fact_table.column_names[:1]
         n = fact_table.num_rows
-        m = -(-max(n, 1) // self.n_dev)
+        agg_leaves = self._agg_leaves(agg) if agg is not None else []
+        has_distinct = any(a.distinct for a in agg_leaves)
+        # out-of-core: stream the fact through the device chunk by chunk
+        # (one compiled program, per-chunk partials combined on the host
+        # exactly like union branches).  DISTINCT needs all rows of a
+        # group in one program, so it keeps the resident path.
+        chunked = (self.chunk_rows is not None and n > self.chunk_rows
+                   and not has_distinct)
+        rows_per = self.chunk_rows if chunked else max(n, 1)
+        m = -(-max(rows_per, 1) // self.n_dev)
         padded = m * self.n_dev
         version = getattr(self.catalog, "versions", {}).get(
             self.fact.table)
         row_sh = NamedSharding(self.mesh, P(SHARD_AXIS))
 
-        dev_args = []
-        metas = []
-        for name in names:
-            c = fact_table.column(name)
-            metas.append((name, c.ctype, c.dictionary))
-            ckey = (self.fact.table, name, version, padded)
-            ent = self.dev_cache.get(ckey)
-            if ent is None:
-                self._evict_stale(self.fact.table, name)
-                data = np.zeros(padded, dtype=c.data.dtype)
-                data[:n] = c.data
-                valid = np.zeros(padded, dtype=bool)
-                valid[:n] = c.validity()
-                ent = (jax.device_put(data, row_sh),
-                       jax.device_put(valid, row_sh))
-                self.dev_cache[ckey] = ent
-            dev_args += [ent[0], ent[1]]
-        akey = (self.fact.table, "__alive__", version, padded)
-        al = self.dev_cache.get(akey)
-        if al is None:
-            self._evict_stale(self.fact.table, "__alive__")
-            alive = np.zeros(padded, dtype=bool)
-            alive[:n] = True
-            al = jax.device_put(alive, row_sh)
-            self.dev_cache[akey] = al
-        dev_args.append(al)
+        metas = [(name, fact_table.column(name).ctype,
+                  fact_table.column(name).dictionary) for name in names]
         self._fact_metas = metas
+
+        def fact_args(start: int) -> list:
+            cnt = max(min(rows_per, n - start), 0)
+            args = []
+            for name in names:
+                c = fact_table.column(name)
+                if chunked:
+                    data = np.zeros(padded, dtype=c.data.dtype)
+                    data[:cnt] = c.data[start:start + cnt]
+                    valid = np.zeros(padded, dtype=bool)
+                    valid[:cnt] = c.validity()[start:start + cnt]
+                    args += [jax.device_put(data, row_sh),
+                             jax.device_put(valid, row_sh)]
+                    continue
+                ckey = (self.fact.table, name, version, padded)
+                ent = self.dev_cache.get(ckey)
+                if ent is None:
+                    self._evict_stale(self.fact.table, name)
+                    data = np.zeros(padded, dtype=c.data.dtype)
+                    data[:n] = c.data
+                    valid = np.zeros(padded, dtype=bool)
+                    valid[:n] = c.validity()
+                    ent = (jax.device_put(data, row_sh),
+                           jax.device_put(valid, row_sh))
+                    self.dev_cache[ckey] = ent
+                args += [ent[0], ent[1]]
+            if chunked:
+                alive = np.zeros(padded, dtype=bool)
+                alive[:cnt] = True
+                args.append(jax.device_put(alive, row_sh))
+            else:
+                akey = (self.fact.table, "__alive__", version, padded)
+                al = self.dev_cache.get(akey)
+                if al is None:
+                    self._evict_stale(self.fact.table, "__alive__")
+                    alive = np.zeros(padded, dtype=bool)
+                    alive[:n] = True
+                    al = jax.device_put(alive, row_sh)
+                    self.dev_cache[akey] = al
+                args.append(al)
+            return args
+
+        self._fact_args_fn = fact_args
+        dev_args = fact_args(0)
 
         # shuffle-join build partitions ride in as extra sharded args
         # (closure constants would be replicated on every device)
@@ -774,9 +1003,7 @@ class DistributedPlanExecutor:
                                 in sj.cols_flat.items()}
             dev_args += dev
         n_args = len(dev_args)
-
-        agg_leaves = self._agg_leaves(agg) if agg is not None else []
-        has_distinct = any(a.distinct for a in agg_leaves)
+        n_fact_args = 2 * len(names) + 1
 
         def body(*args):
             self._cur_args = args
@@ -814,12 +1041,70 @@ class DistributedPlanExecutor:
         self._agg_ctx = (agg, agg_leaves)
         self._compiled_fn = jax.jit(sharded)
         self._dev_args = dev_args
-        out = jax.device_get(self._compiled_fn(*dev_args))
-        return self._post_spine(out)
+        self._chunk_info = (chunked, rows_per, n, n_fact_args)
+        if not chunked:
+            out = jax.device_get(self._compiled_fn(*dev_args))
+            return self._post_spine(out)
+        return self._run_chunks()
+
+    def _run_chunks(self):
+        """Out-of-core execution: stream fact chunks through the one
+        compiled spine program; combine per-chunk outputs on the host
+        (aggregate partials re-group like union branches, row-mode
+        chunks concatenate)."""
+        _chunked, rows_per, n, n_fact_args = self._chunk_info
+        shuffle_args = self._dev_args[n_fact_args:]
+        agg, leaves = self._agg_ctx
+        outs = []
+        dropped_total = 0
+        for start in range(0, max(n, 1), rows_per):
+            args = (self._dev_args[:n_fact_args] if start == 0
+                    else self._fact_args_fn(start))
+            out, dropped = jax.device_get(
+                self._compiled_fn(*(list(args) + shuffle_args)))
+            dropped_total += int(np.asarray(dropped))
+            outs.append(out)
+        self._last_dropped = dropped_total
+        if dropped_total:
+            return None   # _run_spine_retrying re-traces with more slack
+        if agg is None:
+            tables = []
+            for out in outs:
+                flat, alive_out = out[:-1], np.asarray(out[-1])
+                sel = np.nonzero(alive_out)[0]
+                cols = {}
+                for i, (name, ctype, dictionary) in enumerate(
+                        self._row_meta):
+                    data = np.asarray(flat[2 * i])[sel]
+                    valid = np.asarray(flat[2 * i + 1])[sel]
+                    cols[name] = Column(
+                        data, ctype, None if valid.all() else valid,
+                        dictionary)
+                tables.append(Table(cols))
+            return Table.concat(tables)
+        parts = [(*self._unpack_agg(out), list(self._leaf_meta))
+                 for out in outs]
+        if self._emit_partials:
+            # one "branch" worth of partials: chunks simply concatenate
+            # (the union combiner re-groups duplicate keys anyway)
+            kcs = [p[0] for p in parts]
+            merged = Table.concat([Table(kc) for kc in kcs]) \
+                if agg.group_by else Table({})
+            key_cols = dict(merged.columns)
+            leaf_parts = [
+                [np.concatenate([p[1][li][pi] for p in parts])
+                 for pi in range(len(parts[0][1][li]))]
+                for li in range(len(leaves))]
+            return key_cols, leaf_parts
+        return self._finalize_union(agg, leaves, parts)
 
     def _post_spine(self, out):
         out, dropped = out
         self._last_dropped = int(np.asarray(dropped))
+        if self._last_dropped:
+            # truncated by a shuffle bucket overflow: the retry loop
+            # discards this result, skip the host finalize
+            return None
         agg, agg_leaves = self._agg_ctx
         if agg is not None:
             key_cols, leaf_parts = self._unpack_agg(out)
@@ -869,17 +1154,39 @@ class DistributedPlanExecutor:
             return self._broadcast_join(bj, dt)
         raise DistUnsupported(f"{type(p).__name__} in traced spine")
 
-    def _probe_keys(self, evl: JEval, key_exprs, radices, cap):
+    def _probe_keys(self, evl: JEval, key_exprs, radices, cap,
+                    key_dicts=None):
         """Radix-encode the probe-side key parts into one int64 plus
-        NULL/out-of-domain masks (shared by broadcast + shuffle joins)."""
+        NULL/out-of-domain masks (shared by broadcast + shuffle joins).
+        String parts translate probe dictionary codes into the build
+        dictionary's code space via a static (trace-time) mapping."""
         pkey = jnp.zeros(cap, jnp.int64)
         pnull = jnp.zeros(cap, bool)
         in_dom = jnp.ones(cap, bool)
-        for e, (lo, span) in zip(key_exprs, radices):
+        dicts = key_dicts or [None] * len(radices)
+        for e, (lo, span), kd in zip(key_exprs, radices, dicts):
             c = evl.eval(e)
-            if c.ctype.kind not in _KEY_KINDS:
+            if kd is not None:
+                if c.ctype.kind != "string" or c.dictionary is None:
+                    raise DistUnsupported("string key against "
+                                          f"{c.ctype.kind} probe")
+                np_dict = c.dictionary
+                if len(np_dict) and len(kd):
+                    pos = np.searchsorted(kd, np_dict)
+                    posc = np.clip(pos, 0, len(kd) - 1)
+                    ok = kd[posc] == np_dict
+                    mapping = np.where(ok, posc,
+                                       np.int64(len(kd))).astype(np.int64)
+                else:
+                    mapping = np.full(max(len(np_dict), 1), len(kd),
+                                      np.int64)
+                codes = jnp.clip(c.data.astype(jnp.int64), 0,
+                                 max(len(np_dict) - 1, 0))
+                part = jnp.asarray(mapping)[codes]
+            elif c.ctype.kind not in _KEY_KINDS:
                 raise DistUnsupported(f"{c.ctype.kind} probe key")
-            part = c.data.astype(jnp.int64)
+            else:
+                part = c.data.astype(jnp.int64)
             pnull |= ~c.valid
             in_dom &= (part >= lo) & (part < lo + span - 1)
             pkey = pkey * span + jnp.clip(part - lo, 0, span - 1) + 1
@@ -891,7 +1198,8 @@ class DistributedPlanExecutor:
         from ndstpu.parallel import exchange
         cap = dt.capacity
         pkey, pnull, in_dom = self._probe_keys(
-            JEval(dt), sj.probe_key_exprs, sj.radices, cap)
+            JEval(dt), sj.probe_key_exprs, sj.radices, cap,
+            sj.key_dicts)
         pok = ~pnull & in_dom
         # keyless-but-alive rows (NULL / out-of-domain) stay local: they
         # can't match anywhere but must survive left/anti/mark joins
@@ -922,20 +1230,40 @@ class DistributedPlanExecutor:
         # local probe: this device's partition slice of the staged args
         sl = self._cur_args[sj.arg_start: sj.arg_start + sj.n_args]
         lkeys = sl[0]
-        pos = jnp.searchsorted(lkeys, pkey)
-        posc = jnp.clip(pos, 0, lkeys.shape[0] - 1)
-        found = (lkeys[posc] == pkey) & pok
-        bcols: Dict[str, DCol] = {}
-        for i, (name, (_d, _v, ct, dic)) in enumerate(
-                sj.cols_flat.items()):
-            bcols[name] = DCol(sl[1 + 2 * i][posc],
-                               sl[2 + 2 * i][posc] & found, ct, dic)
-        combined = DTable({**dcols, **bcols}, alive)
-        if sj.extra is not None:
-            found = found & JEval(combined).predicate(sj.extra)
-            bcols = {n: DCol(c.data, c.valid & found, c.ctype,
-                             c.dictionary) for n, c in bcols.items()}
+        npart = lkeys.shape[0]
+        if sj.dup_max and sj.extra is not None:
+            # duplicate keys + residual (semi/anti/mark): probe the
+            # whole key run; runs are contiguous inside a partition
+            # because staging sorts each partition by key
+            start = jnp.searchsorted(lkeys, pkey)
+            found = jnp.zeros(ncap, bool)
+            for k in range(sj.dup_max):
+                posk = jnp.clip(start + k, 0, npart - 1)
+                cand = (start + k < npart) & (lkeys[posk] == pkey) & pok
+                bc = {}
+                for i, (name, (_d, _v, ct, dic)) in enumerate(
+                        sj.cols_flat.items()):
+                    bc[name] = DCol(sl[1 + 2 * i][posk],
+                                    sl[2 + 2 * i][posk] & cand, ct, dic)
+                res = JEval(DTable({**dcols, **bc},
+                                   alive)).predicate(sj.extra)
+                found = found | (cand & res)
+            combined = DTable(dcols, alive)
+        else:
+            pos = jnp.searchsorted(lkeys, pkey)
+            posc = jnp.clip(pos, 0, npart - 1)
+            found = (lkeys[posc] == pkey) & pok
+            bcols: Dict[str, DCol] = {}
+            for i, (name, (_d, _v, ct, dic)) in enumerate(
+                    sj.cols_flat.items()):
+                bcols[name] = DCol(sl[1 + 2 * i][posc],
+                                   sl[2 + 2 * i][posc] & found, ct, dic)
             combined = DTable({**dcols, **bcols}, alive)
+            if sj.extra is not None:
+                found = found & JEval(combined).predicate(sj.extra)
+                bcols = {n: DCol(c.data, c.valid & found, c.ctype,
+                                 c.dictionary) for n, c in bcols.items()}
+                combined = DTable({**dcols, **bcols}, alive)
         if sj.kind == "inner":
             return DTable(combined.columns, alive & found)
         if sj.kind == "left":
@@ -960,7 +1288,8 @@ class DistributedPlanExecutor:
     def _broadcast_join(self, bj: _BroadcastJoin, dt: DTable) -> DTable:
         cap = dt.capacity
         pkey, pnull, in_dom = self._probe_keys(
-            JEval(dt), bj.probe_key_exprs, bj.radices, cap)
+            JEval(dt), bj.probe_key_exprs, bj.radices, cap,
+            bj.key_dicts)
         pvalid = ~pnull & in_dom & dt.alive
         bcols: Dict[str, DCol] = {}
         if len(bj.sorted_keys) == 0:
@@ -972,6 +1301,31 @@ class DistributedPlanExecutor:
                 data = jnp.zeros(cap, jnp_dtype(c.ctype))
                 bcols[name] = DCol(data, jnp.zeros(cap, bool), c.ctype,
                                    c.dictionary)
+            combined = DTable({**dt.columns, **bcols}, dt.alive)
+        elif bj.dup_max and bj.extra is not None:
+            # duplicate build keys + residual (semi/anti/mark): probe
+            # every candidate in the key run with an unrolled bounded
+            # loop (q16/q94 correlated-EXISTS self-join shape)
+            skeys = jnp.asarray(bj.sorted_keys)
+            rowof = jnp.asarray(bj.row_of)
+            nb = len(bj.sorted_keys)
+            start = jnp.searchsorted(skeys, pkey)
+            found = jnp.zeros(cap, bool)
+            for k in range(bj.dup_max):
+                posk = jnp.clip(start + k, 0, nb - 1)
+                cand = (start + k < nb) & (skeys[posk] == pkey) & pvalid
+                bidx_k = rowof[posk]
+                bc = {}
+                for name in bj.build.column_names:
+                    c = bj.build.column(name)
+                    bc[name] = DCol(
+                        jnp.asarray(c.data)[bidx_k],
+                        jnp.asarray(c.validity())[bidx_k] & cand,
+                        c.ctype, c.dictionary)
+                res = JEval(DTable({**dt.columns, **bc},
+                                   dt.alive)).predicate(bj.extra)
+                found = found | (cand & res)
+            combined = DTable(dt.columns, dt.alive)
         else:
             skeys = jnp.asarray(bj.sorted_keys)
             pos = jnp.searchsorted(skeys, pkey)
@@ -983,12 +1337,12 @@ class DistributedPlanExecutor:
                 data = jnp.asarray(c.data)[bidx]
                 valid = jnp.asarray(c.validity())[bidx] & found
                 bcols[name] = DCol(data, valid, c.ctype, c.dictionary)
-        combined = DTable({**dt.columns, **bcols}, dt.alive)
-        if bj.extra is not None:
-            found = found & JEval(combined).predicate(bj.extra)
-            bcols = {n: DCol(c.data, c.valid & found, c.ctype,
-                             c.dictionary) for n, c in bcols.items()}
             combined = DTable({**dt.columns, **bcols}, dt.alive)
+            if bj.extra is not None:
+                found = found & JEval(combined).predicate(bj.extra)
+                bcols = {n: DCol(c.data, c.valid & found, c.ctype,
+                                 c.dictionary) for n, c in bcols.items()}
+                combined = DTable({**dt.columns, **bcols}, dt.alive)
         if bj.kind == "inner":
             return DTable(combined.columns, dt.alive & found)
         if bj.kind == "left":
@@ -1417,6 +1771,41 @@ class DistributedPlanExecutor:
             0.0) / denom
         data = var if func in ("var_samp", "variance") else np.sqrt(var)
         return Column(data, FLOAT64, None if ok.all() else ok)
+
+
+def _path_to(root: lp.Plan, target: lp.Plan) -> Optional[List[lp.Plan]]:
+    if root is target:
+        return [root]
+    for c in root.children():
+        p = _path_to(c, target)
+        if p is not None:
+            return [root] + p
+    return None
+
+
+def _distributive_path(root: lp.Plan, target: lp.Plan) -> bool:
+    """Aggregation over the union at `target` may be split per branch
+    only when every node between them distributes over UNION ALL:
+    row-wise ops, inner joins (either side), and probe-side-only for
+    left/semi/anti/mark joins (a build-side union would change match
+    semantics)."""
+    path = _path_to(root, target)
+    if path is None:
+        return False
+    for i, nd in enumerate(path[:-1]):
+        nxt = path[i + 1]
+        if isinstance(nd, (lp.Project, lp.Filter, lp.SubqueryAlias)):
+            continue
+        if isinstance(nd, lp.SetOp) and nd.kind == "union" and nd.all:
+            continue
+        if isinstance(nd, lp.Join):
+            if nd.kind == "inner" or (nxt is nd.left and nd.kind in
+                                      ("left", "semi", "anti",
+                                       "nullaware_anti", "mark")):
+                continue
+            return False
+        return False
+    return True
 
 
 def _output_names(p: lp.Plan, catalog) -> Optional[List[str]]:

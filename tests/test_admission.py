@@ -1,0 +1,60 @@
+"""Device admission control (ndstpu.harness.admission): the
+concurrentGpuTasks analog for concurrent streams on one chip."""
+
+import multiprocessing as mp
+import time
+
+from ndstpu.harness.admission import DeviceAdmission, from_env
+
+
+def _worker(lock_dir, slots, hold_s, out):
+    gate = DeviceAdmission(slots, lock_dir)
+    with gate.slot():
+        out.put(("in", time.time()))
+        time.sleep(hold_s)
+        out.put(("out", time.time()))
+    gate.close()
+
+
+def test_semaphore_bounds_concurrency(tmp_path):
+    """4 processes through a 2-slot gate: at most 2 inside at once.
+    spawn, not fork: the pytest process has live JAX threads."""
+    ctx = mp.get_context("spawn")
+    slots, nproc, hold = 2, 4, 0.3
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker,
+                         args=(str(tmp_path), slots, hold, q))
+             for _ in range(nproc)]
+    for p in procs:
+        p.start()
+    events = []
+    for _ in range(nproc * 2):
+        events.append(q.get(timeout=30))
+    for p in procs:
+        p.join(timeout=30)
+    events.sort(key=lambda e: e[1])
+    inside = peak = 0
+    for kind, _ in events:
+        inside += 1 if kind == "in" else -1
+        peak = max(peak, inside)
+    assert peak <= slots, f"{peak} streams inside a {slots}-slot gate"
+    assert peak >= 1
+
+
+def test_same_process_reacquire(tmp_path):
+    gate = DeviceAdmission(1, str(tmp_path))
+    with gate.slot():
+        pass
+    with gate.slot():   # releasing must allow re-acquisition
+        pass
+    gate.close()
+
+
+def test_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("NDSTPU_ADMISSION_SLOTS", raising=False)
+    assert from_env() is None
+    monkeypatch.setenv("NDSTPU_ADMISSION_SLOTS", "3")
+    monkeypatch.setenv("NDSTPU_ADMISSION_DIR", str(tmp_path))
+    gate = from_env()
+    assert gate is not None and gate.slots == 3
+    gate.close()

@@ -407,6 +407,155 @@ def test_dist_union_all_aggregate(dist_catalog, mesh8):
         assert sorted(map(str, exe.execute_again().to_rows())) == rg
 
 
+def test_dist_string_join_keys(dist_catalog, mesh8):
+    # string keys join in the build dictionary's code space; the traced
+    # probe translates its codes through a static mapping (q56/q60 shape)
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select count(*) as n, sum(ss_ext_sales_price) as s "
+                 "from store_sales, item where ss_item_sk = i_item_sk "
+                 "and i_item_id in (select i_item_id from item "
+                 "where i_color in ('red', 'blue'))")
+
+
+def test_dist_semi_anti_residual_runs(dist_catalog, mesh8):
+    # duplicate build keys + correlated residual: the probe walks the
+    # whole key run (q16/q94 EXISTS self-join shape), on both the
+    # broadcast and the all_to_all shuffle paths
+    sql_exists = (
+        "select count(*) as c from web_sales ws1 where exists "
+        "(select 1 from web_sales ws2 where ws1.ws_order_number = "
+        "ws2.ws_order_number and ws1.ws_warehouse_sk <> "
+        "ws2.ws_warehouse_sk)")
+    sql_not = sql_exists.replace("where exists", "where not exists")
+    for sql in (sql_exists, sql_not):
+        _dist_vs_cpu(dist_catalog, mesh8, sql, threshold=500)
+        _dist_vs_cpu(dist_catalog, mesh8, sql, threshold=500,
+                     broadcast_limit=50, expect_shuffle=1)
+
+
+def test_dist_multi_union_sites(dist_catalog, mesh8):
+    # a q5-shaped plan: rollup over channels whose unions sit UNDER the
+    # per-channel aggregates; every union site must distribute (the
+    # executor recurses on the plan remainder)
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    sql = (
+        "select chan, sum(amt) as total from ("
+        " select 'c1' as chan, sk, amt from ("
+        "  select ss_store_sk as sk, ss_net_profit as amt from store_sales"
+        "  union all select sr_store_sk as sk, (0 - sr_return_amt) as amt "
+        "  from store_returns) a, store where sk = s_store_sk"
+        " union all"
+        " select 'c2' as chan, sk2, amt2 from ("
+        "  select ws_web_site_sk as sk2, ws_net_profit as amt2 "
+        "  from web_sales"
+        "  union all select wr_web_page_sk as sk2, (0 - wr_return_amt) "
+        "  as amt2 from web_returns) b"
+        ") t group by rollup(chan)")
+    plan, _ = sess.plan(sql)
+    want = physical.execute(plan, dist_catalog)
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=500)
+    got = exe.execute_plan(plan)
+    assert exe._union_ctx is not None
+    rw = sorted(map(str, want.to_rows()))
+    assert sorted(map(str, got.to_rows())) == rw
+    assert sorted(map(str, exe.execute_again().to_rows())) == rw
+
+
+def test_dist_out_of_core_chunks(dist_catalog, mesh8):
+    """chunk_rows streams the fact through the device chunk by chunk
+    (one compiled program); per-chunk partials combine on the host like
+    union branches, row-mode chunks concatenate."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    queries = [
+        "select d_year, i_brand_id, sum(ss_ext_sales_price) as s, "
+        "count(*) as n from store_sales, date_dim, item "
+        "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+        "group by d_year, i_brand_id",
+        "select i_category, sum(ss_net_profit) as p, "
+        "min(ss_sales_price) as lo from store_sales, item "
+        "where ss_item_sk = i_item_sk group by rollup(i_category)",
+        "select ss_item_sk, ss_quantity from store_sales "
+        "where ss_quantity > 90",
+        "select count(*) as c, sum(ss_quantity) as q from store_sales, "
+        "store_returns where ss_item_sk = sr_item_sk "
+        "and ss_ticket_number = sr_ticket_number",
+    ]
+    for sql in queries:
+        plan, _ = sess.plan(sql)
+        want = physical.execute(plan, dist_catalog)
+        exe = dplan.DistributedPlanExecutor(
+            dist_catalog, mesh8, shard_threshold_rows=500,
+            broadcast_limit_rows=50, chunk_rows=1000)
+        got = exe.execute_plan(plan)
+        assert exe._chunk_info[0], f"not chunked: {sql[:50]}"
+        rw = sorted(map(str, want.to_rows()))
+        assert sorted(map(str, got.to_rows())) == rw, sql[:60]
+        assert sorted(map(str, exe.execute_again().to_rows())) == rw
+
+
+def test_dist_dup_insensitive_semi_conversion(dist_catalog, mesh8):
+    # q37/q82 shape: an expanding inner join (inventory's non-unique
+    # item keys) feeding a pure GROUP BY dedup — demoted to a semi join
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select i_item_id, i_current_price from item, inventory, "
+                 "store_sales where i_item_sk = inv_item_sk "
+                 "and i_item_sk = ss_item_sk "
+                 "and inv_quantity_on_hand between 100 and 500 "
+                 "group by i_item_id, i_current_price")
+
+
+SPMD_CORPUS_TPLS = [
+    "query2.tpl",    # CTE union reused twice (multi union sites)
+    "query5.tpl",    # rollup over channels with nested unions
+    "query16.tpl",   # semi/anti self-join with residual runs
+    "query37.tpl",   # expanding inventory join -> semi conversion
+    "query56.tpl",   # string join keys in union channels
+    "query75.tpl",   # multi-channel union with fact-fact joins
+    "query82.tpl",   # expanding inventory join -> semi conversion
+    "query94.tpl",   # EXISTS/NOT EXISTS self-join residual runs
+]
+
+
+@pytest.mark.parametrize("tpl", SPMD_CORPUS_TPLS)
+def test_spmd_corpus_differential(dist_catalog, mesh8, tpl):
+    """The corpus queries that exercise the newest distributed paths
+    must DISTRIBUTE (no fallback) and match the numpy oracle."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+    from ndstpu.queries import streamgen
+
+    sess = Session(dist_catalog, backend="cpu")
+    for _name, sql in streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+        plan, _ = sess.plan(sql)
+        want = physical.execute(plan, dist_catalog)
+        exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                            shard_threshold_rows=500)
+        got = exe.execute_plan(plan)   # DistUnsupported = regression
+        rows_w = sorted(want.to_rows(), key=lambda r: tuple(
+            (v is None, str(v)) for v in r))
+        rows_g = sorted(got.to_rows(), key=lambda r: tuple(
+            (v is None, str(v)) for v in r))
+        assert want.column_names == got.column_names
+        assert len(rows_w) == len(rows_g)
+        for rw, rg in zip(rows_w, rows_g):
+            for vw, vg in zip(rw, rg):
+                if isinstance(vw, float) and isinstance(vg, float):
+                    assert vw == pytest.approx(vg, rel=1e-7, abs=1e-7)
+                else:
+                    assert vw == vg, f"{rw} != {rg}"
+
+
 def test_dist_unsupported_falls_out(dist_catalog, mesh8):
     from ndstpu.engine.session import Session
     from ndstpu.parallel import dplan
