@@ -149,6 +149,12 @@ def run_query_stream(args) -> None:
     catalog = loader.load_catalog(args.input_prefix,
                                   use_decimal=not args.floats)
     sess = Session(catalog, backend=args.engine)
+    # distributed-engine knobs via the property channel (the analog of
+    # spark.sql.shuffle.partitions etc. flowing from the template)
+    if engine_conf.get("spmd.threshold_rows"):
+        sess.spmd_threshold = int(engine_conf["spmd.threshold_rows"])
+    if engine_conf.get("spmd.chunk_rows"):
+        sess.spmd_chunk_rows = int(engine_conf["spmd.chunk_rows"])
     execution_times.append(
         (app_id, "CreateTempView all tables",
          int((time.time() - load_start) * 1000)))

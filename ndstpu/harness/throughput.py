@@ -59,7 +59,15 @@ def main(argv: List[str]) -> int:
         if i + 1 >= len(head):
             print("--concurrent requires a value", file=sys.stderr)
             return 2
-        concurrent = int(head[i + 1])
+        try:
+            concurrent = int(head[i + 1])
+        except ValueError:
+            print(f"--concurrent: not an integer: {head[i + 1]}",
+                  file=sys.stderr)
+            return 2
+        if concurrent < 1:
+            print("--concurrent must be >= 1", file=sys.stderr)
+            return 2
         head = head[:i] + head[i + 2:]
     if sep is not None:
         ids_arg, cmd = head, argv[sep + 1:]

@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for the engine's hot operators."""

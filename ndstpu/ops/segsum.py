@@ -1,0 +1,181 @@
+"""Pallas TPU kernel: grouped aggregation as a one-hot MXU matmul.
+
+The NDS power run's hot operator is the scan→filter→group-by spine
+(SURVEY.md §3.1); its inner reduction is a masked segment-sum over a
+dense, small key domain (dimension surrogate keys — items, brands,
+stores).  XLA lowers ``segment_sum`` to scatter-adds; on TPU the
+systolic array gives a faster formulation when the segment count is
+small: a one-hot matrix product,
+
+    partial[s] = Σ_i vals[i] · (gid[i] == s)  ==  vals @ one_hot(gid)
+
+which runs on the MXU at matmul throughput instead of the VPU scatter
+path.  The kernel tiles rows × segments on a 2-D grid, materializes the
+one-hot block in VMEM, and accumulates output tiles across row blocks
+(sequential TPU grid).
+
+Two entry points:
+
+* :func:`segment_sum_f32` — float32 data (f32 matmul accumulation).
+* :func:`segment_sum_decimal` — EXACT int64 sums: values are biased to
+  non-negative and split into 8-bit limbs; each limb's one-hot matmul
+  stays within f32's exact-integer range (block_rows · 255 < 2^24), the
+  per-limb partials accumulate in int32, and the caller-side combine
+  reassembles int64 and removes the bias with the per-segment count.
+  Exactness bound: rows ≤ 2^31 / 255 ≈ 8.4M per call (chunk above it).
+
+Tests run the interpreter (CPU); the real lowering targets the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl
+
+_LANES = 128
+# |value| must stay below the bias so biased values are non-negative
+# and fit the limb planes: 2^41 cents ≈ $22B per single value
+_BIAS_BITS = 41
+_LIMB_BITS = 8
+_N_LIMBS = 6              # biased values < 2^42; 6 limbs cover 48 bits
+
+
+def _pad_to(x, mult: int, fill=0):
+    n = x.shape[0]
+    m = -(-max(n, 1) // mult) * mult
+    if m == n:
+        return x
+    return jnp.concatenate([x, jnp.full((m - n,), fill, x.dtype)])
+
+
+def _f32_kernel(vals_ref, gid_ref, out_ref):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    seg0 = j * out_ref.shape[1]
+    b = vals_ref.shape[0] * vals_ref.shape[1]
+    v = vals_ref[...].reshape(1, b)
+    g = gid_ref[...].reshape(b, 1)
+    seg = seg0 + jax.lax.broadcasted_iota(jnp.int32, (b, out_ref.shape[1]),
+                                          1)
+    onehot = (g == seg).astype(jnp.float32)
+    partial = jnp.dot(v, onehot, preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "block_rows",
+                                    "block_segs", "interpret"))
+def segment_sum_f32(vals: jnp.ndarray, gid: jnp.ndarray,
+                    mask: jnp.ndarray, num_segments: int,
+                    block_rows: int = 1024, block_segs: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Masked per-segment float32 sums via one-hot MXU matmuls.
+
+    ``gid`` entries outside [0, num_segments) contribute nothing (the
+    mask is folded the same way)."""
+    v = jnp.where(mask, vals.astype(jnp.float32), 0.0)
+    g = jnp.where(mask, gid.astype(jnp.int32), jnp.int32(-1))
+    v = _pad_to(v, block_rows)
+    g = _pad_to(g, block_rows, fill=-1)
+    n = v.shape[0]
+    s_pad = -(-max(num_segments, 1) // block_segs) * block_segs
+    rows = block_rows // _LANES
+    v2 = v.reshape(n // _LANES, _LANES)
+    g2 = g.reshape(n // _LANES, _LANES)
+    grid = (n // block_rows, s_pad // block_segs)
+    out = pl.pallas_call(
+        _f32_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_segs), lambda i, j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((1, s_pad), jnp.float32),
+        interpret=interpret,
+    )(v2, g2)
+    return out[0, :num_segments]
+
+
+def _limb_kernel(limbs_ref, gid_ref, out_ref):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    seg0 = j * out_ref.shape[1]
+    nl = limbs_ref.shape[0]
+    b = limbs_ref.shape[1] * limbs_ref.shape[2]
+    g = gid_ref[...].reshape(b, 1)
+    seg = seg0 + jax.lax.broadcasted_iota(jnp.int32, (b, out_ref.shape[1]),
+                                          1)
+    onehot = (g == seg).astype(jnp.float32)
+    lv = limbs_ref[...].reshape(nl, b)
+    partial = jnp.dot(lv, onehot, preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += partial.astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_segments", "block_rows",
+                                    "block_segs", "interpret"))
+def segment_sum_decimal(vals: jnp.ndarray, gid: jnp.ndarray,
+                        mask: jnp.ndarray, num_segments: int,
+                        block_rows: int = 1024, block_segs: int = 256,
+                        interpret: bool = False):
+    """EXACT per-segment int64 sums + counts for scaled-decimal data.
+
+    Returns ``(sums int64 [num_segments], counts int64 [num_segments])``.
+    """
+    if vals.shape[0] > (2 ** 31 - 1) // 255:
+        raise ValueError("segment_sum_decimal: chunk rows above the "
+                         "int32 accumulator bound")
+    bias = jnp.int64(1) << _BIAS_BITS
+    v = jnp.where(mask, vals.astype(jnp.int64) + bias, jnp.int64(0))
+    g = jnp.where(mask, gid.astype(jnp.int32), jnp.int32(-1))
+    v = _pad_to(v, block_rows)
+    g = _pad_to(g, block_rows, fill=-1)
+    n = v.shape[0]
+    s_pad = -(-max(num_segments, 1) // block_segs) * block_segs
+    rows = block_rows // _LANES
+    # 8-bit limb planes (+ one plane of ones for the per-segment count)
+    limbs = [((v >> (_LIMB_BITS * k)) & 0xFF).astype(jnp.float32)
+             for k in range(_N_LIMBS)]
+    limbs.append((v != 0).astype(jnp.float32))   # count plane
+    lv = jnp.stack(limbs).reshape(_N_LIMBS + 1, n // _LANES, _LANES)
+    g2 = g.reshape(n // _LANES, _LANES)
+    grid = (n // block_rows, s_pad // block_segs)
+    out = pl.pallas_call(
+        _limb_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_N_LIMBS + 1, rows, _LANES),
+                         lambda i, j: (0, i, 0)),
+            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_N_LIMBS + 1, block_segs),
+                               lambda i, j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((_N_LIMBS + 1, s_pad), jnp.int32),
+        interpret=interpret,
+    )(lv, g2)
+    out = out[:, :num_segments].astype(jnp.int64)
+    counts = out[_N_LIMBS]
+    sums = jnp.zeros(num_segments, jnp.int64)
+    for k in range(_N_LIMBS):
+        sums = sums + (out[k] << (_LIMB_BITS * k))
+    sums = sums - counts * (jnp.int64(1) << _BIAS_BITS)
+    return sums, counts

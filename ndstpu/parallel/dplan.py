@@ -300,16 +300,15 @@ class DistributedPlanExecutor:
                 continue
             direct = [s for s in node.child.walk()
                       if isinstance(s, lp.SetOp) and s.kind == "union"
-                      and s.all and _distributive_path(node.child, s)]
+                      and s.all and _distributive_path(node.child, s)
+                      and union_size(s) >= self.threshold]
             if not direct:
                 continue
-            # outermost first: nested unions inside a branch are
-            # flattened into extra branches by _expand_branches
+            # outermost first among sharded-size sites: nested unions
+            # inside a branch are flattened by _expand_branches
             s = min(direct,
                     key=lambda s: (len(_path_to(node.child, s) or ()),
                                    -union_size(s)))
-            if union_size(s) < self.threshold:
-                continue
             if best is None or depth > best[0]:
                 best = (depth, node, s)
         return (best[1], best[2]) if best is not None else None
@@ -352,7 +351,8 @@ class DistributedPlanExecutor:
                                  list(agg.aggs), None)
             exe = DistributedPlanExecutor(
                 self.catalog, self.mesh, self.threshold,
-                self.broadcast_limit, self.dev_cache)
+                self.broadcast_limit, self.dev_cache,
+                chunk_rows=self.chunk_rows)
             try:
                 kc, lps = exe.collect_partials(bplan)
                 parts.append((kc, lps, list(exe._leaf_meta)))
@@ -380,7 +380,8 @@ class DistributedPlanExecutor:
         self._union_rest = rest
         nxt = DistributedPlanExecutor(
             self.catalog, self.mesh, self.threshold,
-            self.broadcast_limit, self.dev_cache)
+            self.broadcast_limit, self.dev_cache,
+            chunk_rows=self.chunk_rows)
         try:
             out = nxt.execute_plan(rest)
             self._union_next = nxt
@@ -389,13 +390,14 @@ class DistributedPlanExecutor:
             self._union_next = None
             return self.np_exec.execute(rest)
 
-    @staticmethod
-    def _expand_branches(branches: List[lp.Plan],
+    def _expand_branches(self, branches: List[lp.Plan],
                          cap: int = 16) -> List[lp.Plan]:
         """Flatten unions NESTED inside branches into extra top-level
         branches while the path to them distributes over UNION ALL
         (q5 shape: each channel joins dims onto an inner sales∪returns
-        union).  Branches beyond `cap` stay unexpanded (host fallback)."""
+        union).  Branches beyond `cap` stay unexpanded (host fallback).
+        Union semantics are positional, so every nested side is aligned
+        to its union's left-side names before grafting."""
         work = list(branches)
         out: List[lp.Plan] = []
         while work:
@@ -418,10 +420,25 @@ class DistributedPlanExecutor:
                         sides.append(side)
 
             flat(inner)
-            if len(out) + len(work) + len(sides) > cap:
-                out.append(b)
+            left_names = _output_names(sides[0], self.catalog)
+            aligned: Optional[List[lp.Plan]] = []
+            for i, s in enumerate(sides):
+                if i == 0:
+                    aligned.append(s)
+                    continue
+                sn = _output_names(s, self.catalog)
+                if left_names is None or sn is None or \
+                        len(sn) != len(left_names):
+                    aligned = None
+                    break
+                aligned.append(lp.Project(
+                    s, [(ln, ex.ColumnRef(n))
+                        for ln, n in zip(left_names, sn)]))
+            if aligned is None or \
+                    len(out) + len(work) + len(aligned) > cap:
+                out.append(b)   # unexpandable: keep whole (host path)
                 continue
-            work = [_graft(b, inner, s) for s in sides] + work
+            work = [_graft(b, inner, s) for s in aligned] + work
         return out
 
     def _union_again(self) -> Table:
@@ -1064,6 +1081,8 @@ class DistributedPlanExecutor:
                 self._compiled_fn(*(list(args) + shuffle_args)))
             dropped_total += int(np.asarray(dropped))
             outs.append(out)
+            if dropped_total:
+                break   # the whole pass is discarded and retried
         self._last_dropped = dropped_total
         if dropped_total:
             return None   # _run_spine_retrying re-traces with more slack

@@ -1,0 +1,93 @@
+"""Micro-bench: XLA segment_sum vs the pallas one-hot MXU kernel on the
+real chip (the grouped-aggregation hot op at NDS power-run shapes).
+
+Usage:  python scripts/pallas_bench.py [rows] [segments]
+
+Prints per-variant wall times and a JSON summary line.  Falls back to
+interpret mode (and says so) when no TPU is attached.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ndstpu.ops import segsum  # noqa: E402
+
+
+def timeit(fn, *args, reps=5):
+    fn(*args)  # compile
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+    segs = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    platform = jax.devices()[0].platform
+    interpret = platform not in ("tpu", "axon")
+    rng = np.random.RandomState(0)
+    vals_f = jnp.asarray(rng.uniform(-100, 100, rows).astype(np.float32))
+    vals_d = jnp.asarray(rng.randint(-10**9, 10**9, rows).astype(np.int64))
+    gid = jnp.asarray(rng.randint(0, segs, rows).astype(np.int32))
+    mask = jnp.asarray(rng.rand(rows) < 0.8)
+
+    @jax.jit
+    def xla_f32(v, g, m):
+        return jax.ops.segment_sum(jnp.where(m, v, 0.0), g,
+                                   num_segments=segs)
+
+    @jax.jit
+    def xla_i64(v, g, m):
+        return jax.ops.segment_sum(
+            jnp.where(m, v.astype(jnp.int64), 0), g, num_segments=segs)
+
+    import functools
+    pl_f32 = functools.partial(segsum.segment_sum_f32,
+                               num_segments=segs, interpret=interpret)
+    pl_dec = functools.partial(segsum.segment_sum_decimal,
+                               num_segments=segs, interpret=interpret)
+
+    t_xla_f = timeit(xla_f32, vals_f, gid, mask)
+    t_pl_f = timeit(lambda v, g, m: pl_f32(v, g, m), vals_f, gid, mask)
+    t_xla_i = timeit(xla_i64, vals_d, gid, mask)
+    t_pl_d = timeit(lambda v, g, m: pl_dec(v, g, m)[0], vals_d, gid, mask)
+
+    # correctness spot-check against XLA
+    a = np.asarray(xla_f32(vals_f, gid, mask))
+    b = np.asarray(pl_f32(vals_f, gid, mask))
+    np.testing.assert_allclose(a, b, rtol=3e-4, atol=1.0)
+    ai = np.asarray(xla_i64(vals_d, gid, mask))
+    bi = np.asarray(pl_dec(vals_d, gid, mask)[0])
+    np.testing.assert_array_equal(ai, bi)
+
+    print(f"platform={platform} interpret={interpret} "
+          f"rows={rows} segs={segs}")
+    print(f"xla  segment_sum f32 : {t_xla_f*1e3:9.3f} ms")
+    print(f"pallas one-hot   f32 : {t_pl_f*1e3:9.3f} ms "
+          f"({t_xla_f/t_pl_f:.2f}x)")
+    print(f"xla  segment_sum i64 : {t_xla_i*1e3:9.3f} ms")
+    print(f"pallas limbs     i64 : {t_pl_d*1e3:9.3f} ms "
+          f"({t_xla_i/t_pl_d:.2f}x)")
+    print(json.dumps({
+        "rows": rows, "segs": segs, "platform": platform,
+        "xla_f32_ms": round(t_xla_f * 1e3, 3),
+        "pallas_f32_ms": round(t_pl_f * 1e3, 3),
+        "xla_i64_ms": round(t_xla_i * 1e3, 3),
+        "pallas_i64_ms": round(t_pl_d * 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
