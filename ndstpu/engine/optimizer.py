@@ -369,6 +369,15 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
             keys.extend(n.keys)
             if n.extra is not None:
                 extras.append(n.extra)
+        elif isinstance(n, lp.Filter) and isinstance(n.child, lp.Join) \
+                and n.child.kind in ("inner", "cross"):
+            # filters commute with inner joins: lift a mid-tree residual
+            # (e.g. q72's inv_quantity_on_hand < cs_quantity, pushed onto
+            # the syntactic cs x inventory join) so it cannot glue a
+            # catastrophic join pair together; it is re-applied as soon
+            # as its refs are joined below.
+            extras.extend(_conjuncts(n.condition))
+            flatten(n.child)
         else:
             leaves.append(n)
 
@@ -402,6 +411,21 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
     remaining = set(range(len(leaves))) - joined
     used = [False] * len(edges)
 
+    # residual-key equalities + lifted filters, applied as soon as every
+    # referenced column is available (early filtering keeps expanding
+    # joins like q72's inventory chain from materializing unfiltered)
+    pending = [ex.BinOp("=", le, re_) for le, re_ in residual_keys] + extras
+    avail = set(cols[start])
+
+    def apply_ready(cur: lp.Plan) -> lp.Plan:
+        nonlocal pending
+        ready = [c for c in pending if _refs(c) <= avail]
+        if ready:
+            pending = [c for c in pending if not (_refs(c) <= avail)]
+            cur = lp.Filter(cur, _conjoin(ready))
+        return cur
+
+    current = apply_ready(current)
     while remaining:
         # candidates connected to the joined set
         cand: Dict[int, List[int]] = {}
@@ -428,10 +452,10 @@ def reorder_joins(p: lp.Plan, catalog) -> lp.Plan:
             current = lp.Join(current, leaves[nxt], "cross", [])
         joined.add(nxt)
         remaining.discard(nxt)
+        avail |= set(cols[nxt])
+        current = apply_ready(current)
 
-    # keys that span >2 leaves or got orphaned become residual filters
-    conds = [ex.BinOp("=", le, re_) for le, re_ in residual_keys] + extras
-    cond = _conjoin(conds)
+    cond = _conjoin(pending)
     return lp.Filter(current, cond) if cond is not None else current
 
 
