@@ -101,6 +101,12 @@ class DCol:
     valid: jnp.ndarray          # bool, same capacity
     ctype: DType
     dictionary: Optional[np.ndarray] = None   # host-side, sorted
+    # host-side static (lo, hi) over the column's valid values, set at
+    # upload and preserved by row-subset ops (gather/filter); lets
+    # group-by linearize small integer key domains without sorting.
+    # Invalidation rides the same contract as `dictionary`: data changes
+    # bump the catalog version, which forces re-upload + re-trace.
+    bounds: Optional[Tuple[int, int]] = None
 
     @property
     def capacity(self) -> int:
@@ -129,7 +135,8 @@ class DTable:
         return DTable({n: self.columns[n] for n in names}, self.alive)
 
     def gather(self, idx: jnp.ndarray, alive: jnp.ndarray) -> "DTable":
-        cols = {n: DCol(c.data[idx], c.valid[idx], c.ctype, c.dictionary)
+        cols = {n: DCol(c.data[idx], c.valid[idx], c.ctype, c.dictionary,
+                        c.bounds)
                 for n, c in self.columns.items()}
         return DTable(cols, alive)
 
@@ -175,9 +182,15 @@ def to_device(t: Table, cap: Optional[int] = None) -> DTable:
     cap = cap or size_class(n)
     cols: Dict[str, DCol] = {}
     for name, c in t.columns.items():
-        data = jnp.asarray(_pad(np.asarray(c.data), cap))
+        host = np.asarray(c.data)
+        data = jnp.asarray(_pad(host, cap))
         valid = jnp.asarray(_pad(c.validity(), cap, False))
-        cols[name] = DCol(data, valid, c.ctype, c.dictionary)
+        bounds = None
+        if c.ctype.kind in ("int32", "int64") and n > 0:
+            hv = host[c.validity()[:n]] if c.valid is not None else host[:n]
+            if len(hv):
+                bounds = (int(hv.min()), int(hv.max()))
+        cols[name] = DCol(data, valid, c.ctype, c.dictionary, bounds)
     alive = jnp.asarray(_pad(np.ones(n, dtype=bool), cap, False))
     return DTable(cols, alive)
 
@@ -880,6 +893,15 @@ class JaxExecutor:
         self._used_fallback = False
         # compiled-query cache: plan identity -> _CompiledPlan
         self._compiled: Dict[int, "_CompiledPlan"] = {}
+        # group-by strategy: "sort" = lexsort dense-rank only; "auto" =
+        # linearized gid when the key domain is small (skips the sort);
+        # "pallas" = auto + one-hot MXU segment sums for exact
+        # decimal/int aggregates (ndstpu.ops.segsum).  Read once per
+        # executor: the choice is baked into traced programs.
+        import os as _os
+        self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "auto")
+        self.groupby_domain_cap = int(
+            _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 16)))
 
     # -- public --------------------------------------------------------------
 
@@ -1180,7 +1202,14 @@ class JaxExecutor:
                          c.ctype, c.dictionary)
             key_cols.append((name, c))
         self._grouping_ctx = ([n for n, _ in p.group_by], subset)
-        if key_cols:
+        use_pallas = False
+        direct = None
+        if key_cols and self.groupby_mode in ("auto", "pallas"):
+            direct = self._direct_group_ids(key_cols, dt.alive)
+        if direct is not None:
+            gid, ngseg, out_alive, out_cols, order = direct
+            use_pallas = self.groupby_mode == "pallas"
+        elif key_cols:
             keys = [_key_i64(c, dt.alive) for _, c in key_cols]
             gid, order, newgrp = _group_ids(keys)
             ngseg = cap
@@ -1210,8 +1239,71 @@ class JaxExecutor:
         for name, e in p.aggs:
             out_cols[name] = self._eval_agg(
                 dt, evl, self._resolve_subqueries(e), gid, ngseg, out_alive,
-                order)
+                order, use_pallas)
         return DTable(out_cols, out_alive)
+
+    def _direct_group_ids(self, key_cols, alive):
+        """Linearized group ids for small host-known key domains.
+
+        When every group key is dictionary-coded or carries static
+        bounds, the (keys) tuple maps bijectively to a mixed-radix index
+        over ``domain = prod(span_i + 1)`` slots (+1 = a NULL slot per
+        key), so dense group ids need NO sort, segment reductions run
+        over ``domain`` slots instead of the row capacity, and the one-
+        hot MXU kernels apply.  Returns None when ineligible; then the
+        sort-based path runs.  (Sort path analog of Spark's hash vs
+        sort aggregate choice; reference picks per-plan the same way.)
+        """
+        parts = []
+        domain = 1
+        for _name, c in key_cols:
+            if c.dictionary is not None and c.ctype.kind == "string":
+                lo, span = 0, len(c.dictionary)
+            elif c.bounds is not None and c.ctype.kind in ("int32", "int64"):
+                lo, hi = c.bounds
+                span = hi - lo + 1
+            else:
+                return None
+            if span <= 0:
+                return None
+            domain *= span + 1
+            if domain > self.groupby_domain_cap:
+                return None
+            parts.append((c, lo, span))
+        cap = int(alive.shape[0])
+        gid = jnp.zeros(cap, jnp.int64)
+        for c, lo, span in parts:
+            idx = jnp.clip(c.data.astype(jnp.int64) - lo, 0, span - 1)
+            idx = jnp.where(c.valid, idx, span)     # NULL slot per key
+            gid = gid * (span + 1) + idx
+        gid = jnp.where(alive, gid, domain)         # dead rows -> trash slot
+        ngseg = domain + 1
+        counts = jax.ops.segment_sum(alive.astype(jnp.int32), gid,
+                                     num_segments=ngseg)
+        out_alive = (counts > 0).at[domain].set(False)
+        # reconstruct key values from the slot index (bijective mapping)
+        rem = jnp.arange(ngseg)
+        idxs = []
+        for c, lo, span in reversed(parts):
+            idxs.append(rem % (span + 1))
+            rem = rem // (span + 1)
+        idxs.reverse()
+        out_cols: Dict[str, DCol] = {}
+        for (name, c), (c2, lo, span), idx in zip(key_cols, parts, idxs):
+            vout = (idx != span) & out_alive
+            data = (lo + jnp.clip(idx, 0, span - 1)).astype(c.data.dtype)
+            out_cols[name] = DCol(data, vout, c.ctype, c.dictionary,
+                                  (lo, lo + span - 1))
+        # float sums need a gid-contiguous row order (df64 compensated
+        # scan); computed lazily — the common decimal/int case skips it
+        memo = {}
+
+        def order_thunk():
+            if "o" not in memo:
+                memo["o"] = jnp.argsort(gid, stable=True)
+            return memo["o"]
+
+        return gid, ngseg, out_alive, out_cols, order_thunk
 
     def _check_agg_supported(self, e: ex.Expr):
         for node in e.walk():
@@ -1226,10 +1318,10 @@ class JaxExecutor:
                     raise Unsupported(f"aggregate {node.func}")
 
     def _eval_agg(self, dt: DTable, evl: JEval, e: ex.Expr, gid, ngseg,
-                  out_alive, order) -> DCol:
+                  out_alive, order, use_pallas: bool = False) -> DCol:
         if isinstance(e, ex.AggExpr):
             return self._agg_column(dt, evl, e, gid, ngseg, out_alive,
-                                    order)
+                                    order, use_pallas)
         if isinstance(e, ex.Func) and e.name == "grouping":
             # grouping(key) = 0 when the key participates in this grouping
             # set, 1 when rolled up (Spark semantics)
@@ -1251,13 +1343,15 @@ class JaxExecutor:
                     name = f"__agg{counter[0]}"
                     counter[0] += 1
                     sub_cols[name] = self._agg_column(
-                        dt, evl, node, gid, ngseg, out_alive, order)
+                        dt, evl, node, gid, ngseg, out_alive, order,
+                        use_pallas)
                     return ex.ColumnRef(name)
                 if isinstance(node, ex.Func) and node.name == "grouping":
                     name = f"__agg{counter[0]}"
                     counter[0] += 1
                     sub_cols[name] = self._eval_agg(
-                        dt, evl, node, gid, ngseg, out_alive, order)
+                        dt, evl, node, gid, ngseg, out_alive, order,
+                        use_pallas)
                     return ex.ColumnRef(name)
                 if isinstance(node, ex.BinOp):
                     return ex.BinOp(node.op, lower(node.left),
@@ -1285,14 +1379,43 @@ class JaxExecutor:
     def _segment_sum_typed(vals, gid, ngseg, kind: str, order):
         """int/decimal sums stay exact s64 segment_sum; float sums use
         the compensated segmented scan (TPU computes f64 at f32
-        precision — ndstpu.engine.df64)."""
+        precision — ndstpu.engine.df64).  `order` may be a lazy thunk
+        (direct group-id path computes the sort only when floats need
+        it)."""
         if kind in ("decimal", "int32", "int64"):
             return jax.ops.segment_sum(vals, gid, num_segments=ngseg)
         from ndstpu.engine import df64
+        if callable(order):
+            order = order()
         return df64.segment_sum_compensated(vals, gid, ngseg, order)
 
+    def _pallas_interpret(self) -> bool:
+        """Mosaic lowering only exists on real TPU backends; everywhere
+        else (CPU tests, host-pinned discovery) run the interpreter."""
+        if self.mode != "replay":
+            return True
+        return jax.devices()[0].platform == "cpu"
+
+    # one-hot MXU segment sums stay exact while every |value| < 2^41
+    # (ndstpu.ops.segsum bias bound) and rows fit the int32 accumulator
+    _PALLAS_ROWS_MAX = (2 ** 31 - 1) // 255
+    _PALLAS_SEGS_MAX = 8192
+
+    def _pallas_sum_ok(self, c: DCol, ngseg: int) -> bool:
+        if ngseg > self._PALLAS_SEGS_MAX or \
+                c.data.shape[0] > self._PALLAS_ROWS_MAX:
+            return False
+        if c.ctype.kind == "int32":
+            return True
+        if c.ctype.kind == "decimal":
+            return c.ctype.precision <= 12      # |v| < 10^12 < 2^41
+        if c.ctype.kind == "int64":
+            return c.bounds is not None and \
+                max(abs(c.bounds[0]), abs(c.bounds[1])) < (1 << 41)
+        return False
+
     def _agg_column(self, dt: DTable, evl: JEval, a: ex.AggExpr, gid, ngseg,
-                    out_alive, order) -> DCol:
+                    out_alive, order, use_pallas: bool = False) -> DCol:
         func = a.func
         alive = dt.alive
         if a.distinct and func in ("count", "sum", "avg") and \
@@ -1306,6 +1429,21 @@ class JaxExecutor:
             return DCol(counts, jnp.ones(ngseg, bool), INT64)
         c = evl.eval(a.arg)
         valid = c.valid & alive
+        if use_pallas and func in ("sum", "avg") and \
+                self._pallas_sum_ok(c, ngseg):
+            # exact int64 sums + counts in one one-hot MXU kernel pass
+            from ndstpu.ops import segsum
+            sums, cnts = segsum.segment_sum_decimal(
+                c.data.astype(jnp.int64), gid, valid, ngseg,
+                interpret=self._pallas_interpret())
+            if func == "sum":
+                if c.ctype.kind == "decimal":
+                    return DCol(sums, cnts > 0, decimal(38, c.ctype.scale))
+                return DCol(sums, cnts > 0, INT64)
+            data = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
+            if c.ctype.kind == "decimal":
+                data = data / (10 ** c.ctype.scale)
+            return DCol(data, cnts > 0, FLOAT64)
         if func == "count":
             counts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                          num_segments=ngseg)
@@ -1347,17 +1485,24 @@ class JaxExecutor:
             out = seg(vals, gid, num_segments=ngseg)
             return DCol(out.astype(c.data.dtype), got, c.ctype, c.dictionary)
         if func in ("stddev_samp", "var_samp", "stddev", "variance"):
+            # shifted two-pass moments (see physical.py analog): center
+            # by the group mean so E[x^2]-E[x]^2 cancellation cannot eat
+            # the variance when mean >> stddev; the (sum d)^2/n term
+            # corrects the mean's own rounding.
             x = evl.cast(c, FLOAT64).data
             xv = jnp.where(valid, x, 0.0)
-            s1 = self._segment_sum_typed(xv, gid, ngseg, "float64", order)
-            s2 = self._segment_sum_typed(xv * xv, gid, ngseg, "float64",
-                                         order)
             cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                       num_segments=ngseg)
+            s1 = self._segment_sum_typed(xv, gid, ngseg, "float64", order)
+            mean = s1 / jnp.maximum(cnt, 1)
+            d = jnp.where(valid, x - mean[gid], 0.0)
+            d1 = self._segment_sum_typed(d, gid, ngseg, "float64", order)
+            d2 = self._segment_sum_typed(d * d, gid, ngseg, "float64",
+                                         order)
             ok = cnt > 1
             denom = jnp.where(ok, cnt - 1, 1)
             var = jnp.maximum(
-                s2 - jnp.where(cnt > 0, s1 * s1 / jnp.maximum(cnt, 1), 0.0),
+                d2 - jnp.where(cnt > 0, d1 * d1 / jnp.maximum(cnt, 1), 0.0),
                 0.0) / denom
             data = var if func in ("var_samp", "variance") else jnp.sqrt(var)
             return DCol(data, ok, FLOAT64)
@@ -2145,7 +2290,7 @@ class CompilingExecutor(JaxExecutor):
         metas = {}
         for name in cp.table_cols:
             dt = self._table_device(name)
-            metas[name] = {n: (c.ctype, c.dictionary)
+            metas[name] = {n: (c.ctype, c.dictionary, c.bounds)
                            for n, c in dt.columns.items()}
 
         def replay(tables):
@@ -2156,7 +2301,7 @@ class CompilingExecutor(JaxExecutor):
             self._rec = cp.record
             self._trace_tables = {}
             for name, (cols, alive) in tables.items():
-                dcols = {n: DCol(d, v, metas[name][n][0], metas[name][n][1])
+                dcols = {n: DCol(d, v, *metas[name][n])
                          for n, (d, v) in cols.items()}
                 self._trace_tables[name] = DTable(dcols, alive)
             try:

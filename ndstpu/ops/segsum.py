@@ -57,8 +57,12 @@ def _pad_to(x, mult: int, fill=0):
 
 
 def _f32_kernel(vals_ref, gid_ref, out_ref):
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    # grid = (segment blocks, row blocks): rows are the REDUCTION dim and
+    # must be innermost — TPU Pallas only keeps an output block resident
+    # across consecutive same-index grid steps, so accumulating across an
+    # outer dim would revisit flushed blocks (wrong results on hardware).
+    j = pl.program_id(0)
+    i = pl.program_id(1)
     seg0 = j * out_ref.shape[1]
     b = vals_ref.shape[0] * vals_ref.shape[1]
     v = vals_ref[...].reshape(1, b)
@@ -95,15 +99,15 @@ def segment_sum_f32(vals: jnp.ndarray, gid: jnp.ndarray,
     rows = block_rows // _LANES
     v2 = v.reshape(n // _LANES, _LANES)
     g2 = g.reshape(n // _LANES, _LANES)
-    grid = (n // block_rows, s_pad // block_segs)
+    grid = (s_pad // block_segs, n // block_rows)
     out = pl.pallas_call(
         _f32_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_segs), lambda i, j: (0, j)),
+        out_specs=pl.BlockSpec((1, block_segs), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((1, s_pad), jnp.float32),
         interpret=interpret,
     )(v2, g2)
@@ -111,8 +115,9 @@ def segment_sum_f32(vals: jnp.ndarray, gid: jnp.ndarray,
 
 
 def _limb_kernel(limbs_ref, gid_ref, out_ref):
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    # same grid orientation as _f32_kernel: rows (reduction) innermost
+    j = pl.program_id(0)
+    i = pl.program_id(1)
     seg0 = j * out_ref.shape[1]
     nl = limbs_ref.shape[0]
     b = limbs_ref.shape[1] * limbs_ref.shape[2]
@@ -158,17 +163,17 @@ def segment_sum_decimal(vals: jnp.ndarray, gid: jnp.ndarray,
     limbs.append((v != 0).astype(jnp.float32))   # count plane
     lv = jnp.stack(limbs).reshape(_N_LIMBS + 1, n // _LANES, _LANES)
     g2 = g.reshape(n // _LANES, _LANES)
-    grid = (n // block_rows, s_pad // block_segs)
+    grid = (s_pad // block_segs, n // block_rows)
     out = pl.pallas_call(
         _limb_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((_N_LIMBS + 1, rows, _LANES),
-                         lambda i, j: (0, i, 0)),
-            pl.BlockSpec((rows, _LANES), lambda i, j: (i, 0)),
+                         lambda j, i: (0, i, 0)),
+            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((_N_LIMBS + 1, block_segs),
-                               lambda i, j: (0, j)),
+                               lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((_N_LIMBS + 1, s_pad), jnp.int32),
         interpret=interpret,
     )(lv, g2)
