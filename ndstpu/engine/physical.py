@@ -543,20 +543,29 @@ class Executor:
             return Column(out.astype(c.data.dtype), c.ctype,
                           None if got.all() else got, c.dictionary)
         if func in ("stddev_samp", "var_samp", "stddev", "variance"):
+            # shifted two-pass moments: raw E[x^2]-E[x]^2 cancels
+            # catastrophically when mean >> stddev (nds_validate's 1e-5
+            # epsilon fails at large SF); centering by the group mean
+            # keeps full precision, with the (sum d)^2/n correction
+            # absorbing the mean's own rounding.
             x = ex.cast_column(c, FLOAT64).data
             if n:
+                cnt = np.bincount(gids[valid], minlength=ngroups)
                 s1 = np.bincount(gids[valid], weights=x[valid],
                                  minlength=ngroups)
-                s2 = np.bincount(gids[valid], weights=x[valid] ** 2,
+                mean = s1 / np.maximum(cnt, 1)
+                d = x[valid] - mean[gids[valid]]
+                d1 = np.bincount(gids[valid], weights=d,
                                  minlength=ngroups)
-                cnt = np.bincount(gids[valid], minlength=ngroups)
+                d2 = np.bincount(gids[valid], weights=d * d,
+                                 minlength=ngroups)
             else:
-                s1 = s2 = np.zeros(ngroups)
+                d1 = d2 = np.zeros(ngroups)
                 cnt = np.zeros(ngroups, dtype=np.int64)
             ok = cnt > 1
             denom = np.where(ok, cnt - 1, 1)
             var = np.maximum(
-                (s2 - np.where(cnt > 0, s1 ** 2 / np.maximum(cnt, 1), 0.0)),
+                (d2 - np.where(cnt > 0, d1 ** 2 / np.maximum(cnt, 1), 0.0)),
                 0.0) / denom
             data = var if func in ("var_samp", "variance") else np.sqrt(var)
             return Column(data, FLOAT64, None if ok.all() else ok)

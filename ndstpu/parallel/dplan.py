@@ -527,15 +527,22 @@ class DistributedPlanExecutor:
             fold = np.minimum if a.func == "min" else np.maximum
             fold.at(acc, gids[valid], vals)
             return [acc, cnt], meta
-        # stddev family
+        # stddev family: partials are [s1, m2, cnt] with m2 the CENTERED
+        # second moment (shifted two-pass); combines use Chan's formula —
+        # raw sum-of-squares cancels catastrophically when mean >> stddev
         x = c.data[valid].astype(np.float64)
         if c.ctype.kind == "decimal":
             x = x / (10 ** c.ctype.scale)
         s1 = np.zeros(ng, np.float64)
-        s2 = np.zeros(ng, np.float64)
         np.add.at(s1, gids[valid], x)
-        np.add.at(s2, gids[valid], x * x)
-        return [s1, s2, cnt], meta
+        mean = s1 / np.maximum(cnt, 1)
+        d = x - mean[gids[valid]]
+        d1 = np.zeros(ng, np.float64)
+        m2 = np.zeros(ng, np.float64)
+        np.add.at(d1, gids[valid], d)
+        np.add.at(m2, gids[valid], d * d)
+        m2 -= np.where(cnt > 0, d1 * d1 / np.maximum(cnt, 1), 0.0)
+        return [s1, m2, cnt], meta
 
     def _finalize_union(self, agg: lp.Aggregate, leaves,
                         parts: List[tuple]) -> Table:
@@ -1537,16 +1544,38 @@ class DistributedPlanExecutor:
             seg = jax.ops.segment_min if a.func == "min" \
                 else jax.ops.segment_max
             return [seg(vals, gid, num_segments=cap), cnt], meta
-        # stddev family
+        # stddev family: [s1, m2(centered), cnt] — see _host_leaf_partial;
+        # Chan combine downstream keeps mean >> stddev cases exact
         x = jnp.where(valid, c.data.astype(jnp.float64), 0.0)
         if c.ctype.kind == "decimal":
             x = x / (10 ** c.ctype.scale)
         s1 = fsum(x)
-        s2 = fsum(x * x)
-        return [s1, s2, cnt], meta
+        mean = s1 / jnp.maximum(cnt, 1)
+        d = jnp.where(valid, x - mean[gid], 0.0)
+        d1 = fsum(d)
+        m2 = fsum(d * d) - jnp.where(
+            cnt > 0, d1 * d1 / jnp.maximum(cnt, 1), 0.0)
+        return [s1, m2, cnt], meta
 
     def _combine_partials(self, a: ex.AggExpr, parts, fgid, total,
                           g_alive):
+        if a.func in ("stddev_samp", "var_samp", "stddev", "variance") \
+                and len(parts) == 3:
+            # Chan combine: M2 = sum m2_i + sum n_i (mean_i - mean)^2.
+            # The correction MUST subtract the means before squaring —
+            # expanding it reintroduces the raw-moment cancellation.
+            s1, m2, cnt = [jnp.where(g_alive, p, jnp.zeros((), p.dtype))
+                           for p in parts]
+            S1 = jax.ops.segment_sum(s1, fgid, num_segments=total)
+            CNT = jax.ops.segment_sum(cnt, fgid, num_segments=total)
+            mean_tot = S1 / jnp.maximum(CNT, 1)
+            mean_i = s1 / jnp.maximum(cnt, 1)
+            dm = mean_i - mean_tot[fgid]
+            corr = jax.ops.segment_sum(
+                jnp.where(cnt > 0, cnt * dm * dm, 0.0), fgid,
+                num_segments=total)
+            M2 = jax.ops.segment_sum(m2, fgid, num_segments=total) + corr
+            return [S1, M2, CNT]
         out = []
         minmax = a.func in ("min", "max")
         for pi, part in enumerate(parts):
@@ -1688,6 +1717,22 @@ class DistributedPlanExecutor:
         func = meta[0]
         has_arg = not (isinstance(a.arg, ex.Star) or a.arg is None)
         cnt = parts[-1] if has_arg and func != "count" else parts[0]
+        if func in ("stddev_samp", "var_samp", "stddev", "variance") \
+                and has_arg and len(parts) == 3:
+            # numpy mirror of the traced Chan combine
+            s1, m2, n_i = parts
+            S1 = np.zeros(ng, np.float64)
+            CNT = np.zeros(ng, np.int64)
+            np.add.at(S1, gids, s1)
+            np.add.at(CNT, gids, n_i)
+            mean_tot = S1 / np.maximum(CNT, 1)
+            mean_i = s1 / np.maximum(n_i, 1)
+            dm = mean_i - mean_tot[gids]
+            corr = np.zeros(ng, np.float64)
+            np.add.at(corr, gids, np.where(n_i > 0, n_i * dm * dm, 0.0))
+            M2 = np.zeros(ng, np.float64)
+            np.add.at(M2, gids, m2)
+            return [S1, M2 + corr, CNT]
         out = []
         for pi, part in enumerate(parts):
             if func in ("min", "max") and pi == 0 and has_arg:
@@ -1781,13 +1826,12 @@ class DistributedPlanExecutor:
                 return Column(v.astype(np.float64), ctype, vopt)
             dtype = columnar.numpy_dtype(ctype)
             return Column(v.astype(dtype), ctype, vopt, dictionary)
-        # stddev family
-        s1, s2, cnt = parts
+        # stddev family: parts[1] is already the centered M2 (Chan
+        # combine upstream) — no raw-moment subtraction left to cancel
+        _s1, m2, cnt = parts
         ok = cnt > 1
         denom = np.where(ok, cnt - 1, 1)
-        var = np.maximum(
-            s2 - np.where(cnt > 0, s1 * s1 / np.maximum(cnt, 1), 0.0),
-            0.0) / denom
+        var = np.maximum(m2, 0.0) / denom
         data = var if func in ("var_samp", "variance") else np.sqrt(var)
         return Column(data, FLOAT64, None if ok.all() else ok)
 

@@ -73,3 +73,38 @@ def test_compensated_segment_sum_wrapper():
     for s in range(nseg):
         want = math.fsum(x[gid == s])
         assert abs(got[s] - want) <= 1e-4, (s, got[s], want)
+
+
+def test_stddev_no_cancellation_all_backends():
+    """mean/stddev ratio ~1e6: the raw-moment formula E[x^2]-E[x]^2
+    loses ~12 digits here and fails the validator's 1e-5 epsilon
+    (nds_validate.py:194-215 analog); the shifted two-pass / Chan
+    combine must hold it on every backend."""
+    from ndstpu.engine.columnar import Column, FLOAT64, INT32, Table
+    from ndstpu.engine.session import Session
+    from ndstpu.io.loader import Catalog
+
+    rng = np.random.RandomState(7)
+    n = 8192
+    g = rng.randint(0, 4, n).astype(np.int32)
+    x = 1e6 + rng.standard_normal(n)          # mean ~1e6, stddev ~1
+    cat = Catalog()
+    cat.register("t", Table({"g": Column(g, INT32),
+                             "x": Column(x, FLOAT64)}))
+    want = {}
+    for gg in range(4):
+        want[gg] = float(np.std(x[g == gg], ddof=1))
+    sql = "select g, stddev_samp(x) as s, var_samp(x) as v " \
+          "from t group by g order by g"
+    for backend in ("cpu", "tpu", "tpu-spmd"):
+        sess = Session(cat, backend=backend, spmd_threshold=1)
+        rows = sess.sql(sql).to_rows()
+        assert len(rows) == 4, (backend, rows)
+        for gg, s, v in rows:
+            rel = abs(s - want[gg]) / want[gg]
+            assert rel < 1e-5, (backend, gg, s, want[gg], rel)
+            assert abs(v - want[gg] ** 2) / want[gg] ** 2 < 1e-5
+        if backend == "tpu-spmd":
+            assert not getattr(sess, "_spmd_errors", None), \
+                sess._spmd_errors
+            assert getattr(sess, "_spmd_used", False)
