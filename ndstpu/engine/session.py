@@ -151,8 +151,9 @@ class Session:
                 pass
             except Exception as e:  # noqa: BLE001
                 # a distributed-executor defect must degrade to the
-                # single-chip path, not fail the query; keep the error
-                # observable for tests/triage
+                # single-chip path, not fail the query; strict mode
+                # (tests/CI) re-raises instead, and the first defect
+                # warns — see _record_spmd_error
                 self._record_spmd_error(e)
         if self.backend in ("tpu", "tpu-spmd"):
             exe = self._jax_executor()
@@ -163,9 +164,22 @@ class Session:
         return physical.execute(plan, self.catalog)
 
     def _record_spmd_error(self, e: Exception) -> None:
+        """A non-DistUnsupported distributed failure is a defect, not a
+        capability gap: NDSTPU_SPMD_STRICT re-raises it (tests/CI), and
+        the first one warns on stderr so a distributed-correctness
+        regression cannot hide as an invisible perf cliff."""
+        import os
+        import sys
+        if os.environ.get("NDSTPU_SPMD_STRICT"):
+            raise e
         errs = getattr(self, "_spmd_errors", None)
         if errs is None:
             errs = self._spmd_errors = []
+        if not errs:
+            print(f"WARNING: distributed executor failed "
+                  f"({type(e).__name__}: {e}); falling back to the "
+                  f"single-chip path (further fallbacks collected in "
+                  f"Session._spmd_errors)", file=sys.stderr)
         errs.append(repr(e))
 
     def _mesh(self):
