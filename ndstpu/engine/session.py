@@ -249,10 +249,10 @@ class Session:
         if self.warehouse is not None:
             import os
 
-            from ndstpu.io import acid
+            from ndstpu.io import lake
             root = os.path.join(self.warehouse, stmt.table)
-            if acid.is_ndslake(root):
-                acid.append(root, columnar.to_arrow(rows))
+            if lake.is_lake(root):
+                lake.append(root, columnar.to_arrow(rows))
         merged = columnar.Table.concat([target, rows])
         self.catalog.register(stmt.table, merged)
         return None
@@ -277,13 +277,13 @@ class Session:
         if self.warehouse is not None:
             import os
 
-            from ndstpu.io import acid
+            from ndstpu.io import lake
             root = os.path.join(self.warehouse, stmt.table)
-            if acid.is_ndslake(root):
+            if lake.is_lake(root):
                 # re-evaluate the WHERE per data file — never assume the
                 # in-memory row order matches file iteration order
                 if stmt.where is None:
-                    acid.delete_rows(
+                    lake.delete_rows(
                         root, lambda at: np.ones(at.num_rows, dtype=bool))
                 else:
                     from ndstpu import schema as nds_schema
@@ -298,6 +298,6 @@ class Session:
                             {f"{stmt.table}.{n}": c
                              for n, c in t.columns.items()})
                         return ex.eval_predicate(rn, bound)
-                    acid.delete_rows(root, pred)
+                    lake.delete_rows(root, pred)
         self.catalog.register(stmt.table, target.filter(~mask))
         return None

@@ -7,8 +7,10 @@ DATE1/DATE2 from the generated delete-date tables (nds_maintenance.py:60-96),
 executes each function's statements under a BenchReport, and writes the
 per-function CSV time log (nds_maintenance.py:204-265).
 
-ACID semantics: the warehouse fact tables must be in the `ndslake` format —
-INSERT INTO appends a snapshot, DELETE writes deletion vectors, and
+ACID semantics: the warehouse fact tables must be in an ACID format —
+`ndslake` (snapshot manifests + deletion vectors, Iceberg analog) or
+`ndsdelta` (transaction log + copy-on-write rewrites, Delta analog);
+INSERT INTO appends, DELETE removes rows transactionally, and
 `ndstpu.harness.rollback` restores pre-maintenance snapshots between runs.
 """
 
@@ -122,7 +124,8 @@ def run_query(args) -> None:
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description="NDS data maintenance (ACID)")
-    p.add_argument("warehouse_path", help="ndslake warehouse directory")
+    p.add_argument("warehouse_path",
+                   help="ACID (ndslake/ndsdelta) warehouse directory")
     p.add_argument("refresh_data_path",
                    help="raw refresh (update) data directory")
     p.add_argument("time_log", help="CSV time log output path")

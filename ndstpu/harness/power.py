@@ -256,7 +256,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("time_log", help="per-query CSV time log output path")
     p.add_argument("--input_format", default="parquet",
                    choices=["parquet", "orc", "avro", "csv", "json",
-                            "ndslake"],
+                            "ndslake", "ndsdelta"],
                    help="warehouse table format")
     p.add_argument("--engine", default="cpu",
                    choices=["cpu", "tpu", "tpu-spmd"],

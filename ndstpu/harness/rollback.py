@@ -3,7 +3,8 @@
 The reference calls Iceberg's `rollback_to_timestamp` on the 6 fact tables
 to undo data maintenance between repeated benchmark runs
 (/root/reference/nds/nds_rollback.py:37-59).  Here the same operation runs
-against the ndslake ACID tables.
+against either ACID format: ndslake (snapshot manifests, Iceberg analog)
+or ndsdelta (transaction log RESTORE, Delta analog).
 """
 
 from __future__ import annotations
@@ -11,7 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 
-from ndstpu.io import acid
+from ndstpu.io import lake
 
 FACT_TABLES = ["store_sales", "store_returns", "catalog_sales",
                "catalog_returns", "web_sales", "web_returns", "inventory"]
@@ -21,10 +22,10 @@ def rollback(warehouse: str, timestamp: float,
              tables=None) -> None:
     for table in tables or FACT_TABLES:
         root = os.path.join(warehouse, table)
-        if not acid.is_ndslake(root):
-            print(f"skip {table}: not an ndslake table")
+        if not lake.is_lake(root):
+            print(f"skip {table}: not an ACID (ndslake/ndsdelta) table")
             continue
-        v = acid.rollback_to_timestamp(root, timestamp)
+        v = lake.rollback_to_timestamp(root, timestamp)
         print(f"rolled back {table} to snapshot v{v}")
 
 

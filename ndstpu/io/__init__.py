@@ -1,4 +1,4 @@
 """IO layer: pipe-CSV ingest, Parquet/ORC/JSON transcode with date
 partitioning, warehouse loading into engine tables, and the ACID
-(`ndslake`) table format used by data maintenance.
+(`ndslake`, `ndsdelta`) table formats used by data maintenance.
 """

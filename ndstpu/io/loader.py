@@ -27,7 +27,7 @@ import pyarrow.dataset as pads
 
 from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
-from ndstpu.io import acid
+from ndstpu.io import lake
 
 
 @dataclass
@@ -102,8 +102,8 @@ def read_warehouse_table(warehouse: str, table: str,
                          columns: Optional[List[str]] = None) -> pa.Table:
     """Read one table from a transcoded warehouse, any supported layout."""
     root = os.path.join(warehouse, table)
-    if acid.is_ndslake(root):
-        return acid.read(root, columns=columns)
+    if lake.is_lake(root):
+        return lake.read(root, columns=columns)
     singles = sorted(glob.glob(os.path.join(root, f"{table}*.parquet")))
     if singles:
         import pyarrow.parquet as pq

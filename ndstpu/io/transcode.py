@@ -97,12 +97,12 @@ def transcode_table(args, table: str, tschema) -> float:
         elif args.output_mode == "errorifexists":
             raise RuntimeError(f"output for {table} already exists")
         # append: fall through, dataset write adds files
-    if args.output_format == "ndslake":
-        from ndstpu.io import acid
-        if os.path.exists(out_root) and acid.is_ndslake(out_root):
-            acid.append(out_root, at)  # append mode
+    if args.output_format in ("ndslake", "ndsdelta"):
+        from ndstpu.io import lake
+        if os.path.exists(out_root) and lake.is_lake(out_root):
+            lake.append(out_root, at)  # append mode
         else:
-            acid.create_table(out_root, at,
+            lake.create_table(args.output_format, out_root, at,
                               partition_col=FACT_PARTITION.get(table))
     elif table in FACT_PARTITION and args.output_format == "parquet":
         _write_partitioned(at, out_root, FACT_PARTITION[table],
@@ -166,7 +166,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="load test report path")
     p.add_argument("--output_format", default="parquet",
                    choices=["parquet", "orc", "avro", "csv", "json",
-                            "ndslake"])
+                            "ndslake", "ndsdelta"])
     p.add_argument("--output_mode", default="overwrite",
                    choices=["overwrite", "append", "ignore", "errorifexists"])
     p.add_argument("--tables", help="comma-separated subset of tables")
