@@ -105,7 +105,13 @@ class Session:
 
     def _execute(self, plan: lp.Plan,
                  key: Optional[str] = None) -> columnar.Table:
-        if self.backend == "tpu-spmd":
+        # single-chip out-of-core: when chunk_rows is set, the `tpu`
+        # backend streams facts through the SAME chunked executor as
+        # tpu-spmd, just over a 1-device mesh (SF >> HBM on one chip;
+        # host partial combine).  Unsupported shapes fall through to
+        # the whole-fact-resident jaxexec path below.
+        if self.backend == "tpu-spmd" or (
+                self.backend == "tpu" and self.spmd_chunk_rows is not None):
             from ndstpu.engine import jaxexec
             from ndstpu.parallel import dplan
             versions = tuple(sorted(
@@ -186,7 +192,10 @@ class Session:
         m = getattr(self, "_mesh_cache", None)
         if m is None:
             from ndstpu.parallel import mesh as pmesh
-            m = pmesh.default_mesh()
+            # tpu = single-chip out-of-core (1-device mesh); tpu-spmd =
+            # every visible device
+            m = pmesh.make_mesh(1) if self.backend == "tpu" \
+                else pmesh.default_mesh()
             self._mesh_cache = m
         return m
 

@@ -583,3 +583,39 @@ def test_mesh_construction():
     assert m.axis_names == (pmesh.SHARD_AXIS,)
     with pytest.raises(ValueError):
         pmesh.make_mesh(10**6)
+
+
+def test_single_chip_out_of_core(dist_catalog):
+    """Session backend='tpu' + spmd_chunk_rows routes aggregates through
+    the chunked executor over a 1-DEVICE mesh (SF >> HBM on one chip,
+    VERDICT weak #7): differential vs the numpy interpreter at an
+    artificially small chunk size, with chunking actually engaged."""
+    from ndstpu.engine.session import Session
+
+    cpu = Session(dist_catalog, backend="cpu")
+    tpu = Session(dist_catalog, backend="tpu",
+                  spmd_threshold=500, spmd_chunk_rows=1000)
+    queries = [
+        "select d_year, i_brand_id, sum(ss_ext_sales_price) as s, "
+        "count(*) as n from store_sales, date_dim, item "
+        "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+        "group by d_year, i_brand_id",
+        # row-mode spine (no aggregate): chunks concatenate
+        "select ss_item_sk, ss_quantity from store_sales "
+        "where ss_quantity > 90",
+    ]
+    for sql in queries:
+        want = sorted(map(str, cpu.sql(sql).to_rows()))
+        got = sorted(map(str, tpu.sql(sql).to_rows()))
+        assert got == want, sql[:60]
+    assert getattr(tpu, "_spmd_used", False)
+    assert not getattr(tpu, "_spmd_errors", None)
+    # the mesh really is single-device
+    assert len(tpu._mesh().devices.ravel()) == 1
+    # chunking engaged on the cached executors
+    chunked = [ent[1]._chunk_info[0]
+               for ent in tpu._spmd_cache.values()]
+    assert any(chunked)
+    # a shape the chunked executor can't take still answers (fallback)
+    out = tpu.sql("select count(*) as n from item")
+    assert out.to_rows()[0][0] == dist_catalog.get("item").num_rows
