@@ -143,8 +143,6 @@ def _replay(table_dir: str, version: Optional[int] = None) -> _State:
     for v in range(start, version + 1):
         path = _commit_path(table_dir, v)
         if not os.path.exists(path):
-            if v <= (cp or -1):
-                continue
             raise FileNotFoundError(f"missing delta commit {v}")
         with open(path) as f:
             for line in f:
@@ -235,7 +233,14 @@ def read(table_dir: str, version: Optional[int] = None,
                            columns=columns)
              for fm in st.files.values()]
     if not parts:
-        raise FileNotFoundError(f"no live files in {table_dir}")
+        # fully-deleted table: 0 rows, schema from any historical data
+        # file (copy-on-write never unlinks them) — ndslake parity
+        for name in sorted(os.listdir(table_dir)):
+            if name.startswith("part-") and name.endswith(".parquet"):
+                at = pq.read_table(os.path.join(table_dir, name),
+                                   columns=columns)
+                return at.slice(0, 0)
+        raise FileNotFoundError(f"no data files in {table_dir}")
     return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
 
 

@@ -100,6 +100,12 @@ def transcode_table(args, table: str, tschema) -> float:
     if args.output_format in ("ndslake", "ndsdelta"):
         from ndstpu.io import lake
         if os.path.exists(out_root) and lake.is_lake(out_root):
+            have = lake.detect(out_root)
+            if have is not lake.module_for(args.output_format):
+                raise RuntimeError(
+                    f"{out_root} already holds the other ACID format; "
+                    f"refusing to append {args.output_format} data into "
+                    f"it (use --output_mode overwrite)")
             lake.append(out_root, at)  # append mode
         else:
             lake.create_table(args.output_format, out_root, at,
