@@ -33,6 +33,20 @@ from ndstpu.engine.columnar import (
 )
 
 
+def scalar_subquery_literal(t: Table,
+                            too_many: type = RuntimeError) -> "ex.Expr":
+    """First column of a (<=1)-row table as a Literal — the inlining
+    step for uncorrelated scalar subqueries, shared by the host
+    interpreter and the distributed offload path (dplan)."""
+    col = t.columns[t.column_names[0]]
+    if t.num_rows == 0:
+        return ex.Literal(None, col.ctype)
+    vals = col.to_pylist()
+    if len(vals) > 1:
+        raise too_many("scalar subquery returned >1 row")
+    return ex.Literal(vals[0], col.ctype)
+
+
 class Executor:
     def __init__(self, catalog):
         self.catalog = catalog
@@ -101,12 +115,7 @@ class Executor:
         t = self.execute(e.plan)
         col = t.columns[t.column_names[0]]
         if e.kind == "scalar":
-            if t.num_rows == 0:
-                return ex.Literal(None, col.ctype)
-            vals = col.to_pylist()
-            if len(vals) > 1:
-                raise RuntimeError("scalar subquery returned >1 row")
-            return ex.Literal(vals[0], col.ctype)
+            return scalar_subquery_literal(t)
         if e.kind == "in":
             pyvals = col.to_pylist()
             has_null = any(v is None for v in pyvals)

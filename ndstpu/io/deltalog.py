@@ -234,12 +234,14 @@ def read(table_dir: str, version: Optional[int] = None,
              for fm in st.files.values()]
     if not parts:
         # fully-deleted table: 0 rows, schema from any historical data
-        # file (copy-on-write never unlinks them) — ndslake parity
+        # file (copy-on-write never unlinks them) — ndslake parity;
+        # schema-only read, no row data touched
         for name in sorted(os.listdir(table_dir)):
             if name.startswith("part-") and name.endswith(".parquet"):
-                at = pq.read_table(os.path.join(table_dir, name),
-                                   columns=columns)
-                return at.slice(0, 0)
+                sch = pq.read_schema(os.path.join(table_dir, name))
+                if columns is not None:
+                    sch = pa.schema([sch.field(c) for c in columns])
+                return sch.empty_table()
         raise FileNotFoundError(f"no data files in {table_dir}")
     return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
 

@@ -85,7 +85,8 @@ class _BroadcastJoin:
     build_empty: bool = False
     # per key part: the build dictionary for string keys (None = numeric)
     key_dicts: Optional[List[Optional[np.ndarray]]] = None
-    # >0: semi/anti/mark residual probes every duplicate in a key run
+    # >0: duplicate build key runs — inner joins EXPAND the probe side
+    # by this factor; semi/anti/mark residuals probe every duplicate
     dup_max: int = 0
 
 
@@ -170,6 +171,9 @@ class DistributedPlanExecutor:
         union = self._try_union_agg(plan)
         if union is not None:
             return union
+        offload = self._try_subquery_offload(plan)
+        if offload is not None:
+            return offload
         scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
         if not scans:
             raise DistUnsupported("no base-table scan in plan")
@@ -193,6 +197,70 @@ class DistributedPlanExecutor:
             self._spine, self._top = spine, top
             return self._finish(result)
         raise last or DistUnsupported("no sharded-size table in plan")
+
+    def _try_subquery_offload(self, plan: lp.Plan) -> Optional[Table]:
+        """q9 shape: the outer plan scans only sub-threshold tables (its
+        FROM is the tiny `reason` dim) while uncorrelated SCALAR
+        subqueries embedded in its expressions aggregate a sharded-size
+        fact.  Execute each such subquery body distributed (one child
+        executor per body), inline the scalars, and run the tiny outer
+        plan on host — the reference distributes these trivially through
+        Spark (query9.tpl's 15 store_sales aggregates)."""
+        for n in plan.walk():
+            if isinstance(n, lp.Scan) and n.table in self.catalog and \
+                    self.catalog.get(n.table).num_rows >= self.threshold:
+                return None     # normal spine path handles it
+        from ndstpu.engine.optimizer import _plan_exprs
+
+        subs: List[ex.SubqueryExpr] = []
+
+        def collect(p: lp.Plan) -> None:
+            for e in _plan_exprs(p):
+                for x in e.walk():
+                    if isinstance(x, ex.SubqueryExpr) and \
+                            x.plan is not None and x.kind == "scalar" and \
+                            not x.correlated_predicates:
+                        subs.append(x)
+            for c in p.children():
+                collect(c)
+
+        collect(plan)
+        targets = [
+            s for s in subs
+            if any(isinstance(n, lp.Scan) and n.table in self.catalog and
+                   self.catalog.get(n.table).num_rows >= self.threshold
+                   for n in s.plan.walk())]
+        if not targets:
+            return None
+        children: List[Tuple[ex.SubqueryExpr,
+                             "DistributedPlanExecutor"]] = []
+        firsts: List[Table] = []
+        for s in targets:
+            child = DistributedPlanExecutor(
+                self.catalog, self.mesh,
+                shard_threshold_rows=self.threshold,
+                broadcast_limit_rows=self.broadcast_limit,
+                dev_cache=self.dev_cache, chunk_rows=self.chunk_rows)
+            firsts.append(child.execute_plan(s.plan))  # DistUnsupported
+            children.append((s, child))                # propagates
+        self._scalar_ctx = (plan, children)
+        return self._scalar_finish(firsts)
+
+    @staticmethod
+    def _scalar_literal(t: Table) -> ex.Expr:
+        return physical.scalar_subquery_literal(t, too_many=DistUnsupported)
+
+    def _scalar_finish(self, results: Optional[List[Table]]) -> Table:
+        """Inline distributed subquery results as literals (pre-seeding
+        the host interpreter's subquery cache) and run the tiny outer
+        plan; `results=None` re-runs the children's compiled spines."""
+        plan, children = self._scalar_ctx
+        self.np_exec = physical.Executor(self.catalog)
+        for i, (s, child) in enumerate(children):
+            out = results[i] if results is not None else \
+                child.execute_again()
+            self.np_exec._subq_cache[id(s)] = self._scalar_literal(out)
+        return self.np_exec.execute(plan)
 
     def collect_partials(self, plan: lp.Aggregate):
         """Run an Aggregate-rooted plan over the mesh and return the raw
@@ -253,6 +321,8 @@ class DistributedPlanExecutor:
         tpu-spmd queries (no re-trace, no re-compile, no host build)."""
         if self._union_ctx is not None:
             return self._union_again()
+        if getattr(self, "_scalar_ctx", None) is not None:
+            return self._scalar_finish(None)
         if getattr(self, "_chunk_info", (False,))[0]:
             return self._finish(self._run_chunks())
         out = jax.device_get(self._compiled_fn(*self._dev_args))
@@ -794,11 +864,22 @@ class DistributedPlanExecutor:
                 kind = "semi"
             dup_max = 0
             if not unique:
-                if kind in ("inner", "left"):
-                    # probe-side cardinality would expand
+                if kind == "left":
+                    # unmatched-row bookkeeping under expansion not built
                     raise DistUnsupported(
-                        f"non-unique build keys for {kind} join")
-                if p.extra is not None:
+                        "non-unique build keys for left join")
+                if kind == "inner":
+                    # bounded duplicate EXPANSION: the probe side tiles
+                    # d copies per row, copy k matching the k-th
+                    # duplicate in the build key run (q72's d1-d2
+                    # week_seq join: 7 days per week)
+                    _, counts = np.unique(skeys, return_counts=True)
+                    dup_max = int(counts.max()) if len(counts) else 0
+                    if dup_max > 8:
+                        raise DistUnsupported(
+                            f"expanding inner join: build key runs too "
+                            f"long ({dup_max})")
+                elif p.extra is not None:
                     # semi/anti/mark with a residual: probe every
                     # duplicate in the key run (bounded unrolled loop,
                     # q16/q94 self-join EXISTS shape)
@@ -811,6 +892,9 @@ class DistributedPlanExecutor:
                         raise DistUnsupported(
                             f"build key runs too long ({dup_max})")
             if build.num_rows > self.broadcast_limit:
+                if dup_max and kind == "inner":
+                    raise DistUnsupported(
+                        "expanding inner join on a shuffle build side")
                 sj = self._stage_shuffle_join(
                     p, kind, probe_exprs, radices, skeys, row_of, build,
                     on_left, bool((~bvalid).any()))
@@ -1328,6 +1412,39 @@ class DistributedPlanExecutor:
                 bcols[name] = DCol(data, jnp.zeros(cap, bool), c.ctype,
                                    c.dictionary)
             combined = DTable({**dt.columns, **bcols}, dt.alive)
+        elif bj.dup_max and bj.kind == "inner":
+            # EXPANDING inner join: tile the probe side d times
+            # (copy-major: expanded row k*cap+i is probe row i matched
+            # against the k-th duplicate in its build key run); dead
+            # copies are masked, downstream ops just see a d-times
+            # capacity (q72's week_seq join, 7 days per week)
+            d = bj.dup_max
+            skeys = jnp.asarray(bj.sorted_keys)
+            rowof = jnp.asarray(bj.row_of)
+            nb = len(bj.sorted_keys)
+            start = jnp.searchsorted(skeys, pkey)
+
+            def tile(a):
+                return jnp.concatenate([a] * d)
+
+            pos = tile(start) + jnp.repeat(jnp.arange(d), cap)
+            posc = jnp.clip(pos, 0, nb - 1)
+            cand = (pos < nb) & (skeys[posc] == tile(pkey)) & tile(pvalid)
+            bidx = rowof[posc]
+            pcols = {n: DCol(tile(c.data), tile(c.valid), c.ctype,
+                             c.dictionary)
+                     for n, c in dt.columns.items()}
+            for name in bj.build.column_names:
+                c = bj.build.column(name)
+                bcols[name] = DCol(
+                    jnp.asarray(c.data)[bidx],
+                    jnp.asarray(c.validity())[bidx] & cand,
+                    c.ctype, c.dictionary)
+            combined = DTable({**pcols, **bcols}, cand)
+            if bj.extra is not None:
+                cand = cand & JEval(combined).predicate(bj.extra)
+                combined = DTable(combined.columns, cand)
+            return combined
         elif bj.dup_max and bj.extra is not None:
             # duplicate build keys + residual (semi/anti/mark): probe
             # every candidate in the key run with an unrolled bounded

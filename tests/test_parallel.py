@@ -619,3 +619,61 @@ def test_single_chip_out_of_core(dist_catalog):
     # a shape the chunked executor can't take still answers (fallback)
     out = tpu.sql("select count(*) as n from item")
     assert out.to_rows()[0][0] == dist_catalog.get("item").num_rows
+
+
+def test_dist_scalar_subquery_offload(dist_catalog, mesh8):
+    """q9 shape: outer FROM is a tiny dim; the work lives in uncorrelated
+    scalar subqueries over the fact. Each body runs distributed (child
+    executors) and the scalars are inlined into the host outer plan."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+    from ndstpu.queries import streamgen
+
+    sess = Session(dist_catalog, backend="cpu")
+    _name, sql = streamgen.render_template_parts(
+        str(streamgen.TEMPLATE_DIR / "query9.tpl"), "07291122510", 0)[0]
+    plan, _ = sess.plan(sql)
+    want = physical.execute(plan, dist_catalog)
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=500)
+    got = exe.execute_plan(plan)
+    assert getattr(exe, "_scalar_ctx", None) is not None
+    assert len(exe._scalar_ctx[1]) == 15      # 5 buckets x (count,avg,avg)
+    assert sorted(map(str, got.to_rows())) == \
+        sorted(map(str, want.to_rows()))
+    assert sorted(map(str, exe.execute_again().to_rows())) == \
+        sorted(map(str, want.to_rows()))
+
+
+def test_dist_expanding_inner_broadcast_join(dist_catalog, mesh8):
+    """Non-unique build keys on an inner broadcast join expand the probe
+    side by bounded duplication (q72's d1-d2 week_seq join: <=7 days per
+    week), instead of falling back to the single-chip path."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    # d2 joins the spine on inv_date_sk (unique), then d1 arrives via
+    # the NON-unique d_week_seq edge and must expand (7 days/week), with
+    # the quantity filter as a lifted residual
+    sql = ("select d1.d_day_name, count(*) as n, "
+           "sum(inv_quantity_on_hand) as q "
+           "from inventory "
+           "join date_dim d2 on inv_date_sk = d2.d_date_sk "
+           "join date_dim d1 on d1.d_week_seq = d2.d_week_seq "
+           "where inv_quantity_on_hand < 500 "
+           "group by d1.d_day_name")
+    plan, _ = sess.plan(sql)
+    want = physical.execute(plan, dist_catalog)
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=500)
+    got = exe.execute_plan(plan)
+    assert any(isinstance(j, dplan._BroadcastJoin) and j.dup_max > 1
+               and j.kind == "inner" for j in exe.joins.values()), \
+        "expansion not engaged"
+    assert sorted(map(str, got.to_rows())) == \
+        sorted(map(str, want.to_rows()))
+    assert sorted(map(str, exe.execute_again().to_rows())) == \
+        sorted(map(str, want.to_rows()))
