@@ -7,18 +7,27 @@ generation merged from a temporary directory, `--update` refresh sets with
 separate placement of the delete-date tables, and an overwrite guard.
 
 The reference's `hdfs` mode (Hadoop MapReduce fan-out, GenTable.java) maps
-here to `dist` mode: the same child-chunk fan-out executed on this host for
-the host's slice of children — on a multi-host TPU pod each host runs the
-driver with its own `--range`, no cluster scheduler needed (chunk content is
-position-deterministic so any assignment of children to hosts is valid).
+here to two modes:
+
+* `dist` — this host's slice of a multi-host run (use `--range`); chunk
+  content is position-deterministic, so any assignment of children to
+  hosts is valid.
+* `pod` — the coordinator: `--hosts h1,h2,...` splits the child chunks
+  into contiguous per-host slices (the NLineInputFormat analog,
+  GenTable.java:188-209) and launches one `dist` driver per host via a
+  launcher template (default `ssh`), all writing to a SHARED data_dir
+  (the HDFS-target analog).  Merged output is byte-identical to a local
+  run with the same scale/parallel/seed.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import shutil
 import subprocess
+import sys
 
 from ndstpu import schema
 from ndstpu.check import (
@@ -88,7 +97,91 @@ def _merge_temp_tables(temp_dir: str, parent_dir: str,
     shutil.rmtree(temp_dir, ignore_errors=True)
 
 
+def _host_slices(parallel: int, hosts: list) -> list:
+    """Contiguous child-chunk slice per host (NLineInputFormat analog:
+    GenTable.java genInput writes one dsdgen command line per mapper)."""
+    n = len(hosts)
+    per = -(-parallel // n)
+    out = []
+    for i, host in enumerate(hosts):
+        lo = i * per + 1
+        hi = min((i + 1) * per, parallel)
+        if lo <= hi:
+            out.append((host, lo, hi))
+    return out
+
+
+def generate_pod(args) -> None:
+    """Coordinator for multi-host generation over a shared filesystem:
+    one `dist --range` driver per host, launched through the
+    `--launcher` template (`{host}` substituted; the per-host slice
+    command is appended as a single shell-quoted argument)."""
+    hosts = [h for h in (args.hosts or "").split(",") if h]
+    if not hosts:
+        raise RuntimeError("pod mode requires --hosts h1,h2,...")
+    data_dir = _prepare_data_dir(args.data_dir, args.overwrite_output)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    procs = []
+    for host, lo, hi in _host_slices(int(args.parallel), hosts):
+        remote = [args.remote_python, "-m", "ndstpu.datagen.driver",
+                  "dist", str(args.scale), str(args.parallel), data_dir,
+                  "--range", f"{lo},{hi}"]
+        if args.update:
+            remote += ["--update", str(args.update)]
+        if args.seed is not None:
+            remote += ["--seed", str(args.seed)]
+        cmd = shlex.split(args.launcher.format(host=host)) + [
+            "cd " + shlex.quote(repo) + " && PYTHONPATH=" +
+            shlex.quote(repo) + " " +
+            " ".join(shlex.quote(a) for a in remote)]
+        procs.append((host, lo, hi, subprocess.Popen(cmd)))
+    failed = []
+    for host, lo, hi, p in procs:
+        p.wait()
+        if p.returncode != 0:
+            failed.append((host, lo, hi, p.returncode))
+    if failed:
+        raise RuntimeError(
+            f"pod generation failed on {failed}; re-run those slices "
+            f"with `dist --range lo,hi` (chunks are deterministic)")
+    # completeness check: every table produced something and no host
+    # left an in-flight temp slice behind (small tables legitimately
+    # emit fewer chunks than `parallel` — only child 1 writes them)
+    tables = MAINTENANCE_TABLE_NAMES if args.update \
+        else SOURCE_TABLE_NAMES
+    empty = [t for t in tables
+             if not os.path.isdir(os.path.join(data_dir, t)) or
+             not os.listdir(os.path.join(data_dir, t))]
+    stale = [d for d in os.listdir(data_dir) if d.startswith("_temp_")]
+    if empty or stale:
+        raise RuntimeError(
+            f"pod generation incomplete: empty tables {empty[:5]}, "
+            f"stale temp slices {stale[:5]}")
+
+
+def _prepare_data_dir(path: str, overwrite: bool) -> str:
+    """Create-or-guard the output dir (shared by local and pod modes);
+    on overwrite, also clear stale _temp_* slices a killed prior run
+    left behind (they would otherwise fail pod's completeness check)."""
+    data_dir = get_abs_path(path)
+    if not os.path.isdir(data_dir):
+        os.makedirs(data_dir)
+        return data_dir
+    if get_dir_size(data_dir) > 0 and not overwrite:
+        raise RuntimeError(
+            f"There's already data in {data_dir}; "
+            "use --overwrite_output to overwrite.")
+    for d in os.listdir(data_dir):
+        if d.startswith("_temp_"):
+            shutil.rmtree(os.path.join(data_dir, d), ignore_errors=True)
+    return data_dir
+
+
 def generate_data(args) -> None:
+    if args.type == "pod":
+        generate_pod(args)
+        return
     tool = check_build()
     range_start, range_end = 1, int(args.parallel)
     if args.range:
@@ -99,18 +192,17 @@ def generate_data(args) -> None:
     if args.range:
         # incremental generation goes to a temp dir, then merges up; a stale
         # temp dir from a failed prior run must not leak into the dataset
-        # (reference guards both sides: nds_gen_data.py clean_temp_data)
-        target_dir = os.path.join(data_dir, "_temp_")
+        # (reference guards both sides: nds_gen_data.py clean_temp_data).
+        # The name carries the range so concurrent per-host slices of a
+        # pod run cannot clobber each other's in-flight chunks.
+        target_dir = os.path.join(data_dir,
+                                  f"_temp_{range_start}_{range_end}_")
         shutil.rmtree(target_dir, ignore_errors=True)
         os.makedirs(target_dir)
     else:
-        if not os.path.isdir(data_dir):
-            os.makedirs(data_dir)
-        elif get_dir_size(data_dir) > 0 and not args.overwrite_output:
-            raise RuntimeError(
-                f"There's already data in {data_dir}; "
-                "use --overwrite_output to overwrite."
-            )
+        data_dir = _prepare_data_dir(args.data_dir,
+                                     args.overwrite_output)
+        target_dir = data_dir
 
     try:
         _fanout(args, range_start, range_end, target_dir, tool)
@@ -127,9 +219,11 @@ def generate_data(args) -> None:
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         description="Generate NDS benchmark data (native seeded generator)")
-    parser.add_argument("type", choices=["local", "dist"],
-                        help="fan-out mode: local multiprocess, or this "
-                        "host's slice of a multi-host run (use --range)")
+    parser.add_argument("type", choices=["local", "dist", "pod"],
+                        help="fan-out mode: local multiprocess; this "
+                        "host's slice of a multi-host run (use --range); "
+                        "or pod coordinator fanning slices out to "
+                        "--hosts over a shared filesystem")
     parser.add_argument("scale", help="volume of data to generate in GB")
     parser.add_argument("parallel", type=parallel_value_type,
                         help="build data in <parallel_value> separate chunks")
@@ -144,6 +238,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "throughput stream)")
     parser.add_argument("--seed", type=int,
                         help="base RNG seed (default: generator built-in)")
+    parser.add_argument("--hosts",
+                        help="pod mode: comma-separated host list; child "
+                        "chunks are split into contiguous per-host slices")
+    parser.add_argument("--launcher", default="ssh -o BatchMode=yes {host}",
+                        help="pod mode: launcher template; {host} is "
+                        "substituted and the slice command is appended as "
+                        "one shell argument (e.g. 'bash -c' to fan out "
+                        "locally for testing)")
+    parser.add_argument("--remote_python", default=sys.executable,
+                        help="pod mode: python interpreter on the hosts")
     return parser
 
 

@@ -128,4 +128,45 @@ def test_driver_range_merge(tool, tmp_path):
             check=True, env=env)
     files = sorted(os.listdir(out / "customer"))
     assert files == [f"customer_{i}_4.dat" for i in (1, 2, 3, 4)]
-    assert not (out / "_temp_").exists()
+    assert not [d for d in os.listdir(out) if d.startswith("_temp_")]
+
+
+def test_pod_mode_byte_identical_to_local(tmp_path):
+    """`pod` mode (host-list fan-out, GenTable.java analog) over a
+    shared directory must produce byte-identical output to a local run
+    with the same scale/parallel: chunks are position-deterministic, so
+    the host assignment cannot matter. Uses `--launcher 'bash -c'` so
+    both 'hosts' are this machine."""
+    import filecmp
+
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    local = tmp_path / "local"
+    pod = tmp_path / "pod"
+    subprocess.run(["python", "-m", "ndstpu.datagen.driver", "local",
+                    "0.002", "4", str(local)], check=True, env=env,
+                   stdout=subprocess.DEVNULL)
+    subprocess.run(["python", "-m", "ndstpu.datagen.driver", "pod",
+                    "0.002", "4", str(pod),
+                    "--hosts", "hostA,hostB",
+                    "--launcher", "bash -c"],
+                   check=True, env=env, stdout=subprocess.DEVNULL)
+    tables = sorted(os.listdir(local))
+    assert sorted(os.listdir(pod)) == tables
+    for table in tables:
+        lfiles = sorted(os.listdir(local / table))
+        pfiles = sorted(os.listdir(pod / table))
+        assert pfiles == lfiles, table
+        for f in lfiles:
+            assert filecmp.cmp(local / table / f, pod / table / f,
+                               shallow=False), f"{table}/{f} differs"
+
+
+def test_pod_mode_failure_reports_slices(tmp_path):
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    r = subprocess.run(
+        ["python", "-m", "ndstpu.datagen.driver", "pod", "0.002", "4",
+         str(tmp_path / "x"), "--hosts", "h1",
+         "--launcher", "false"],  # launcher that always fails
+        env=env, capture_output=True, text=True)
+    assert r.returncode != 0
+    assert "re-run those slices" in r.stderr
