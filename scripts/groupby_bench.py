@@ -1,0 +1,90 @@
+"""A/B the group-by strategies on the real TPU inside actual power-run
+queries (VERDICT round-1 item 3: measure the Pallas path in a power run,
+not just a microbenchmark).
+
+Runs a group-by-heavy query subset under each NDSTPU_GROUPBY mode in a
+fresh subprocess (the mode is baked into traced programs at executor
+init), timing the compiled-replay steady state (second run). Prints a
+per-query table and writes docs/GROUPBY_BENCH.json.
+
+Usage:  python scripts/groupby_bench.py [warehouse_dir] [--modes a,b,c]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# brand/category/channel aggregates — the scan->filter->group-by spine
+QUERIES = ["query3", "query7", "query42", "query52", "query55", "query43"]
+
+
+def run_mode(mode: str, wh: str) -> dict:
+    code = f"""
+import json, sys, time
+sys.path.insert(0, {str(REPO)!r})
+from ndstpu.engine.session import Session
+from ndstpu.io import loader
+from ndstpu.queries import streamgen
+catalog = loader.load_catalog({wh!r})
+sess = Session(catalog, backend="tpu")
+out = {{}}
+for q in {QUERIES!r}:
+    parts = streamgen.render_template_parts(
+        str(streamgen.TEMPLATE_DIR / (q + ".tpl")), "07291122510", 0)
+    for name, sql in parts:
+        sess.sql(sql).to_rows()          # discovery
+        sess.sql(sql).to_rows()          # compile + first replay
+        t0 = time.time()
+        sess.sql(sql).to_rows()          # steady-state replay
+        out[name] = round(time.time() - t0, 4)
+print("RESULT " + json.dumps(out))
+"""
+    env = dict(os.environ, NDSTPU_GROUPBY=mode, PYTHONPATH=str(REPO))
+    t0 = time.time()
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=3600)
+    if r.returncode != 0:
+        print(f"mode {mode} FAILED:\n{r.stderr[-2000:]}", file=sys.stderr)
+        return {}
+    for line in r.stdout.splitlines():
+        if line.startswith("RESULT "):
+            out = json.loads(line[len("RESULT "):])
+            out["__wall__"] = round(time.time() - t0, 1)
+            return out
+    return {}
+
+
+def main() -> None:
+    wh = sys.argv[1] if len(sys.argv) > 1 and not sys.argv[1].startswith(
+        "--") else str(REPO / ".bench_cache" / "wh_sf1")
+    modes = ["sort", "auto", "pallas"]
+    for a in sys.argv:
+        if a.startswith("--modes"):
+            modes = a.split("=", 1)[1].split(",")
+    results = {}
+    for mode in modes:
+        print(f"== mode {mode} ==", flush=True)
+        results[mode] = run_mode(mode, wh)
+        for k, v in results[mode].items():
+            print(f"  {k:24s} {v}", flush=True)
+    qnames = sorted(set().union(*[set(r) for r in results.values()]) -
+                    {"__wall__"})
+    print(f"\n{'query':24s} " + " ".join(f"{m:>9s}" for m in modes))
+    for q in qnames:
+        row = " ".join(f"{results[m].get(q, float('nan')):9.4f}"
+                       for m in modes)
+        print(f"{q:24s} {row}")
+    with open(REPO / "docs" / "GROUPBY_BENCH.json", "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
