@@ -202,9 +202,13 @@ def _ensure_warehouse() -> str:
     return wh
 
 
+_BACKEND_DEAD = ("UNAVAILABLE", "worker process crashed", "DATA_LOSS")
+
+
 def _power_run(sess, queries, times: dict, failed: list,
                stop_at: float) -> bool:
     """Run the stream serially; returns True iff every query ran."""
+    accel = sess.backend != "cpu"
     for name, sql in queries:
         if time.time() >= stop_at:
             return False
@@ -218,6 +222,13 @@ def _power_run(sess, queries, times: dict, failed: list,
             print(f"BENCH-ERROR {name}: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
             failed.append(name)
+            if accel and any(tok in str(e) for tok in _BACKEND_DEAD):
+                # the TPU worker died: every further query would fail
+                # the same way — abort this run so the report stays
+                # scoped to what actually executed
+                print("BENCH-WARNING: backend unavailable, aborting run",
+                      file=sys.stderr, flush=True)
+                return False
     return True
 
 
@@ -250,11 +261,13 @@ def main() -> None:
     # CPU baseline first: it is bounded (~minutes at SF1) while a
     # cold-cache TPU pass may not finish inside the budget — the
     # vs_baseline denominator must exist even when the TPU pass is cut.
+    # NDSTPU_BENCH_CPU=0 skips it (cache-warming reruns).
     STATE["phase"] = "cpu-baseline"
-    cpu_sess = Session(catalog, backend="cpu")
-    cpu_stop = time.time() + max(60.0, _remaining() * 0.45)
-    _power_run(cpu_sess, queries, STATE["cpu_times"], STATE["cpu_failed"],
-               cpu_stop)
+    if os.environ.get("NDSTPU_BENCH_CPU", "1") != "0":
+        cpu_sess = Session(catalog, backend="cpu")
+        cpu_stop = time.time() + max(60.0, _remaining() * 0.45)
+        _power_run(cpu_sess, queries, STATE["cpu_times"],
+                   STATE["cpu_failed"], cpu_stop)
     if STATE["cpu_failed"]:
         print(f"BENCH-WARNING: {len(STATE['cpu_failed'])} baseline "
               f"queries failed: {sorted(STATE['cpu_failed'])}",

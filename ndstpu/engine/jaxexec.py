@@ -1078,7 +1078,12 @@ class JaxExecutor:
         if cached is not None and cached[0] == version and \
                 version is not None:
             return cached[1]
-        dt = to_device(host)
+        # always materialize on the HOST backend: this cache feeds
+        # eager/discovery and replay metadata; pinning a second full
+        # copy of every table in accelerator HBM (alongside the
+        # per-column replay buffers) starved the device at SF1
+        with host_compute():
+            dt = to_device(host)
         self._device_cache[name] = (version, dt)
         return dt
 
@@ -2270,21 +2275,42 @@ class CompilingExecutor(JaxExecutor):
                  for n in names}, dt.alive)
 
     def _accel_args(self, name: str, cols: Optional[List[str]] = None):
-        """Replay inputs, resident on the accelerator (uploaded once per
-        (table version, column subset); the host copy feeds
-        eager/discovery)."""
+        """Replay inputs, resident on the accelerator.  Cached per
+        (table version, COLUMN) — different queries scan overlapping
+        column subsets, and caching whole subsets pinned duplicate
+        copies of every shared column in HBM (at SF1 the accumulation
+        crashed the TPU worker under the big rollup programs).  Args
+        are assembled from the shared per-column buffers; the structure
+        the jitted replay sees is unchanged."""
         version = getattr(self.catalog, "versions", {}).get(name)
-        ckey = (name, None if cols is None else tuple(sorted(cols)))
-        cached = self._accel_cache.get(ckey)
-        if cached is not None and cached[0] == version and \
-                version is not None:
-            return cached[1]
-        args = self._table_args(name, cols)
         dev = jax.devices()[0]
-        if dev.platform != "cpu":
-            args = jax.device_put(args, dev)
-        self._accel_cache[ckey] = (version, args)
-        return args
+        if dev.platform == "cpu":
+            return self._table_args(name, cols)
+        dt = self._table_device(name)
+        names = dt.column_names if cols is None else cols
+        akey = (name, None)     # None can never be a column name
+        ent = self._accel_cache.get(akey)
+        if ent is None or ent[0] != version or version is None:
+            # version changed: drop every stale buffer of this table
+            for k in [k for k in self._accel_cache if k[0] == name]:
+                del self._accel_cache[k]
+            self._accel_cache[akey] = (
+                version, jax.device_put(dt.alive, dev))
+        alive = self._accel_cache[akey][1]
+        missing = [n for n in names
+                   if self._accel_cache.get((name, n)) is None or
+                   self._accel_cache[(name, n)][0] != version or
+                   version is None]
+        if missing:
+            # one batched transfer for every missing column (per-column
+            # device_put would pay the tunnel round-trip per call)
+            up = jax.device_put(
+                {n: (dt.columns[n].data, dt.columns[n].valid)
+                 for n in missing}, dev)
+            for n in missing:
+                self._accel_cache[(name, n)] = (version, up[n])
+        return ({n: self._accel_cache[(name, n)][1] for n in names},
+                alive)
 
     def _build_jit(self, cp: _CompiledPlan):
         metas = {}
