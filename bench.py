@@ -303,6 +303,22 @@ def main() -> None:
         if ri + 1 < n_runs and _remaining() - 60.0 < est:
             break
 
+    # a deadline-cut first run mixes compile time into its per-query
+    # numbers; if no complete run exists but some queries compiled,
+    # spend whatever budget is left on a steady-state pass over that
+    # subset so the headline measures execution, not compilation
+    runs = STATE["tpu_runs"]
+    if runs and not any(r["complete"] and not r["failed"] for r in runs):
+        done = [(n, s) for n, s in queries
+                if n in runs[-1]["times"] and
+                n not in runs[-1]["failed"]]
+        if done and _remaining() > 60.0:
+            STATE["phase"] = "tpu-steady-subset"
+            run = {"times": {}, "failed": [], "complete": False}
+            STATE["tpu_runs"].append(run)
+            _power_run(tpu_sess, done, run["times"], run["failed"],
+                       DEADLINE - 20.0)
+
     STATE["phase"] = "done"
     _emit()
 
