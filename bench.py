@@ -203,32 +203,74 @@ def _ensure_warehouse() -> str:
 
 
 _BACKEND_DEAD = ("UNAVAILABLE", "worker process crashed", "DATA_LOSS")
+# a wedged remote-compile RPC blocks forever (observed: query39 at SF1);
+# abandon the query in its daemon thread and keep the stream moving
+QUERY_TIMEOUT_S = float(os.environ.get("NDSTPU_BENCH_QUERY_TIMEOUT_S",
+                                       "900"))
+
+
+def _run_one(sess, sql: str, slot: dict) -> None:
+    try:
+        out = sess.sql(sql)
+        out.to_rows()  # materialize like collect() (nds_power.py:124-134)
+        slot["ok"] = True
+    except Exception as e:  # noqa: BLE001
+        slot["err"] = e
 
 
 def _power_run(sess, queries, times: dict, failed: list,
                stop_at: float) -> bool:
     """Run the stream serially; returns True iff every query ran."""
+    import threading
     accel = sess.backend != "cpu"
+    hangs = 0
     for name, sql in queries:
         if time.time() >= stop_at:
             return False
         t0 = time.time()
-        try:
-            out = sess.sql(sql)
-            out.to_rows()  # materialize like collect() (nds_power.py:124-134)
-            times[name] = round(time.time() - t0, 4)
-        except Exception as e:  # noqa: BLE001 — a failed query must not
-            # zero the whole 99-query benchmark (report taints instead)
-            print(f"BENCH-ERROR {name}: {type(e).__name__}: {e}",
-                  file=sys.stderr, flush=True)
-            failed.append(name)
-            if accel and any(tok in str(e) for tok in _BACKEND_DEAD):
-                # the TPU worker died: every further query would fail
-                # the same way — abort this run so the report stays
-                # scoped to what actually executed
-                print("BENCH-WARNING: backend unavailable, aborting run",
+        slot: dict = {}
+        if accel:
+            th = threading.Thread(target=_run_one, args=(sess, sql, slot),
+                                  daemon=True)
+            th.start()
+            waited = min(QUERY_TIMEOUT_S, max(30.0, stop_at - time.time()))
+            th.join(waited)
+            if th.is_alive():
+                if waited < QUERY_TIMEOUT_S:
+                    # deadline cut an ordinary query, not a hang
+                    return False
+                # Known tradeoff: the zombie thread stays blocked inside
+                # its jax call on the shared session; continuing risks a
+                # rare completion-time race, but aborting here would cap
+                # coverage at the first wedged program — and any crash
+                # still emits the partial JSON via the signal handlers.
+                print(f"BENCH-ERROR {name}: hang (> "
+                      f"{QUERY_TIMEOUT_S:.0f}s), abandoned",
                       file=sys.stderr, flush=True)
-                return False
+                failed.append(name)
+                hangs += 1
+                if hangs >= 3:  # backend wedged, not one bad program
+                    print("BENCH-WARNING: repeated hangs, aborting run",
+                          file=sys.stderr, flush=True)
+                    return False
+                continue
+        else:
+            _run_one(sess, sql, slot)
+        if slot.get("ok"):
+            times[name] = round(time.time() - t0, 4)
+            continue
+        e = slot.get("err")
+        # a failed query must not zero the whole 99-query benchmark
+        print(f"BENCH-ERROR {name}: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        failed.append(name)
+        if accel and any(tok in str(e) for tok in _BACKEND_DEAD):
+            # the TPU worker died: every further query would fail the
+            # same way — abort this run so the report stays scoped to
+            # what actually executed
+            print("BENCH-WARNING: backend unavailable, aborting run",
+                  file=sys.stderr, flush=True)
+            return False
     return True
 
 

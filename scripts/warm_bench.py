@@ -1,0 +1,73 @@
+"""Warm the SF-N power-run caches query by query, with visibility and a
+per-query watchdog: a query whose compile/execution hangs (wedged remote
+compile RPC) is abandoned after --timeout seconds in a daemon thread and
+the loop continues, so one pathological program cannot block the rest of
+the corpus from warming."""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> None:
+    # normalize the tag exactly like bench.py (f"{SF:g}"), so "1.0"
+    # warms the same wh_sf1 / plans_sf1.pkl paths the bench reads
+    sf = f"{float(os.environ.get('NDSTPU_BENCH_SF', '1')):g}"
+    per_q = float(os.environ.get("NDSTPU_WARM_QUERY_TIMEOUT_S", "1500"))
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      str(REPO / ".bench_cache" / "xla_cache_tpu"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.queries import streamgen
+
+    wh = str(REPO / ".bench_cache" / f"wh_sf{sf}")
+    catalog = loader.load_catalog(wh)
+    sess = Session(catalog, backend="tpu")
+    rec = str(REPO / ".bench_cache" / f"plans_sf{sf}.pkl")
+    try:
+        print("preloaded", sess.preload_compiled(rec), flush=True)
+    except Exception as e:
+        print("preload failed:", e, flush=True)
+
+    queries = []
+    for tpl in streamgen.list_templates():
+        queries.extend(streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+    start = sys.argv[1] if len(sys.argv) > 1 else None
+    skipping = start is not None
+    from bench import _run_one  # shared per-query worker (repo root)
+    for name, sql in queries:
+        if skipping:
+            if name == start:
+                skipping = False
+            else:
+                continue
+        slot: dict = {}
+        th = threading.Thread(target=_run_one, args=(sess, sql, slot),
+                              daemon=True)
+        t0 = time.time()
+        th.start()
+        th.join(per_q)
+        if th.is_alive():
+            print(f"HANG {name} (> {per_q:.0f}s) — abandoned", flush=True)
+        elif not slot.get("ok"):
+            print(f"FAIL {name}: {str(slot.get('err'))[:200]}", flush=True)
+        else:
+            print(f"OK   {name} {round(time.time() - t0, 1)}", flush=True)
+        try:
+            sess.save_compiled(rec)
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    main()
