@@ -73,42 +73,59 @@ def ds_to_f64(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
     return hi.astype(jnp.float64) + lo.astype(jnp.float64)
 
 
-def segment_sum_ds(x: jnp.ndarray, gid_sorted: jnp.ndarray,
-                   num_segments: int
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Compensated per-segment sum over rows pre-sorted by segment id.
+def segment_sum_ds_multi(xs, gid_sorted: jnp.ndarray, num_segments: int):
+    """Compensated per-segment sums of N value streams over ONE shared
+    segmented scan (the (hi, lo) carry widens per stream; scan overhead
+    is paid once).
 
-    ``x`` float64 values in sorted-segment order (invalid rows must be
-    zeroed), ``gid_sorted`` the matching non-decreasing segment ids.
-    Returns per-segment (hi, lo) f32 pairs; combine with
-    :func:`ds_to_f64` (host-side for full effect).
+    Each ``xs[i]`` holds float64 values in sorted-segment order (invalid
+    rows must be zeroed); ``gid_sorted`` the matching non-decreasing
+    segment ids.  Returns a list of per-segment (hi, lo) f32 pairs;
+    combine with :func:`ds_to_f64` (host-side for full effect).
     """
-    n = x.shape[0]
+    n = xs[0].shape[0]
+    k = len(xs)
     if n == 0:
         z = jnp.zeros(num_segments, jnp.float32)
-        return z, z
-    hi, lo = ds_from_f64(x)
+        return [(z, z)] * k
+    pairs = [ds_from_f64(x) for x in xs]
 
     def combine(a, b):
-        ga, ha, la = a
-        gb, hb, lb = b
+        ga, gb = a[0], b[0]
         same = ga == gb
-        nh, nl = ds_add(jnp.where(same, ha, 0.0),
-                        jnp.where(same, la, 0.0), hb, lb)
-        return gb, nh, nl
+        out = [gb]
+        for i in range(k):
+            ah, al = a[1 + 2 * i], a[2 + 2 * i]
+            bh, bl = b[1 + 2 * i], b[2 + 2 * i]
+            nh, nl = ds_add(jnp.where(same, ah, 0.0),
+                            jnp.where(same, al, 0.0), bh, bl)
+            out += [nh, nl]
+        return tuple(out)
 
-    g, sh, sl = lax.associative_scan(
-        combine, (gid_sorted.astype(jnp.int64), hi, lo))
+    carry = (gid_sorted.astype(jnp.int32),) + tuple(
+        p for pair in pairs for p in pair)
+    res = lax.associative_scan(combine, carry)
+    g = res[0]
     # segment totals sit at each segment's last row; scatter-add so the
     # non-last rows (adding 0.0) can never clobber a total the way a
     # duplicate-index scatter-set could
     last = jnp.ones(n, bool).at[:-1].set(g[:-1] != g[1:])
     seg = jnp.clip(g, 0, num_segments - 1)
-    out_hi = jnp.zeros(num_segments, jnp.float32).at[seg].add(
-        jnp.where(last, sh, 0.0))
-    out_lo = jnp.zeros(num_segments, jnp.float32).at[seg].add(
-        jnp.where(last, sl, 0.0))
-    return out_hi, out_lo
+    zero = jnp.zeros(num_segments, jnp.float32)
+    out = []
+    for i in range(k):
+        sh, sl = res[1 + 2 * i], res[2 + 2 * i]
+        out.append((zero.at[seg].add(jnp.where(last, sh, 0.0)),
+                    zero.at[seg].add(jnp.where(last, sl, 0.0))))
+    return out
+
+
+def segment_sum_ds(x: jnp.ndarray, gid_sorted: jnp.ndarray,
+                   num_segments: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compensated per-segment sum over rows pre-sorted by segment id
+    (single-stream wrapper over :func:`segment_sum_ds_multi`)."""
+    return segment_sum_ds_multi([x], gid_sorted, num_segments)[0]
 
 
 def segment_sum_compensated(x: jnp.ndarray, gid: jnp.ndarray,
@@ -119,3 +136,15 @@ def segment_sum_compensated(x: jnp.ndarray, gid: jnp.ndarray,
     per-segment sums accumulated at ~2^-48 instead of f32 drift."""
     hi, lo = segment_sum_ds(x[order], gid[order], num_segments)
     return ds_to_f64(hi, lo)
+
+
+def segment_sum_compensated2(x1: jnp.ndarray, x2: jnp.ndarray,
+                             gid: jnp.ndarray, num_segments: int,
+                             order: jnp.ndarray):
+    """Two compensated segment sums over the SAME segmentation in ONE
+    associative scan (doubled (hi, lo) carry).  Halves the scan HLO for
+    callers that need paired moments (stddev's d and d^2)."""
+    gs = gid[order]
+    (h1, l1), (h2, l2) = segment_sum_ds_multi(
+        [x1[order], x2[order]], gs, num_segments)
+    return ds_to_f64(h1, l1), ds_to_f64(h2, l2)

@@ -70,6 +70,13 @@ from ndstpu.engine.columnar import (  # noqa: E402
 # Sentinels (int64 key space)
 _NULL_KEY = np.int64(-(2 ** 62))      # NULL group/join key
 _DEAD_KEY = np.int64(2 ** 62)         # padding / filtered-out rows
+# int32 key space (narrow keys: v5e has no native int64 ALU — the x64
+# rewrite emulates every s64 op as s32 pairs, so keys whose domain fits
+# int32 cut the VPU work of sorts/compares in half or better)
+_NULL32 = np.int32(-(2 ** 30))
+_DEAD32 = np.int32(2 ** 30)
+_ORD_DEAD32 = np.int32(2 ** 30 + 1)   # order keys: dead strictly last
+_NARROW_LIM = 2 ** 30                 # |value| bound for int32 keys
 _MIN_CAPACITY = 256
 
 
@@ -186,7 +193,7 @@ def to_device(t: Table, cap: Optional[int] = None) -> DTable:
         data = jnp.asarray(_pad(host, cap))
         valid = jnp.asarray(_pad(c.validity(), cap, False))
         bounds = None
-        if c.ctype.kind in ("int32", "int64") and n > 0:
+        if c.ctype.kind in ("int32", "int64", "date", "decimal") and n > 0:
             hv = host[c.validity()[:n]] if c.valid is not None else host[:n]
             if len(hv):
                 bounds = (int(hv.min()), int(hv.max()))
@@ -216,8 +223,9 @@ class Unsupported(Exception):
 
 
 def _civil_from_days(days: jnp.ndarray):
-    """days since 1970-01-01 -> (year, month, day), integer math only."""
-    z = days.astype(jnp.int64) + 719468
+    """days since 1970-01-01 -> (year, month, day), integer math only
+    (int32 throughout: |days| < 2^21 for any representable date)."""
+    z = days.astype(jnp.int32) + 719468
     era = jnp.floor_divide(z, 146097)
     doe = z - era * 146097
     yoe = jnp.floor_divide(
@@ -491,8 +499,8 @@ class JEval:
         lk, rk = lc.ctype.kind, rc.ctype.kind
         valid = lc.valid & rc.valid
         if lk == "date" and rk in ("int32", "int64"):
-            data = (lc.data.astype(jnp.int64) +
-                    (rc.data if op == "+" else -rc.data)).astype(jnp.int32)
+            delta = rc.data.astype(jnp.int32)
+            data = lc.data + (delta if op == "+" else -delta)
             return DCol(data, valid, DATE)
         if op == "/":
             ld = self.cast(lc, FLOAT64).data
@@ -773,6 +781,18 @@ class JEval:
 # ---------------------------------------------------------------------------
 
 
+def _minmax_vals(data: jnp.ndarray, valid: jnp.ndarray, kind: str,
+                 is_min: bool) -> jnp.ndarray:
+    """Reduction input for min/max in the data's NATIVE dtype: invalid
+    rows filled with the dtype's own extremum (the reduction identity).
+    Bool widens to int32 (no iinfo for bool)."""
+    if kind == "bool":
+        data = data.astype(jnp.int32)
+    info = jnp.iinfo(data.dtype)
+    sent = data.dtype.type(info.max if is_min else info.min)
+    return jnp.where(valid, data, sent)
+
+
 def _sum_input(data: jnp.ndarray, valid: jnp.ndarray, kind: str):
     """Summation input under the TPU precision rule: decimal/int sums
     stay exact int64 (s64 is exactly emulated on TPU via s32 pairs);
@@ -820,39 +840,76 @@ def _key_i64(c: DCol, alive: jnp.ndarray,
 
 def _lexsort_order(keys: List[jnp.ndarray],
                    stable: bool = True) -> jnp.ndarray:
-    """argsort by multiple keys; keys[0] is the primary."""
+    """argsort by multiple keys; keys[0] is the primary.
+
+    ONE variadic ``lax.sort`` (num_keys=len(keys)) with an int32 iota
+    payload — not a chain of per-key argsorts: a single sort HLO on TPU
+    costs roughly one sort regardless of key count, and the int32
+    permutation avoids x64's default int64 index arrays."""
     n = keys[0].shape[0]
-    order = jnp.arange(n)
-    for k in reversed(keys):
-        order = order[jnp.argsort(k[order], stable=True)]
-    return order
+    iota = jax.lax.iota(jnp.int32, n)
+    return jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+                        is_stable=True)[-1]
 
 
 def _group_ids(keys: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray,
                                                  jnp.ndarray]:
-    """Dense group ids via sort: returns (gid per row, order, newgrp)."""
-    order = _lexsort_order(keys)
+    """Dense group ids via ONE variadic sort: (gid int32, order int32,
+    newgrp).  Sorted key columns come straight out of the sort — no
+    per-key re-gather."""
     n = keys[0].shape[0]
+    iota = jax.lax.iota(jnp.int32, n)
+    res = jax.lax.sort(tuple(keys) + (iota,), num_keys=len(keys),
+                       is_stable=True)
+    order = res[-1]
     diff = jnp.zeros(n, bool).at[0].set(True)
-    for k in keys:
-        ks = k[order]
+    for ks in res[:-1]:
         diff = diff.at[1:].set(diff[1:] | (ks[1:] != ks[:-1]))
-    gid_sorted = jnp.cumsum(diff) - 1
-    gid = jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
+    gid_sorted = jnp.cumsum(diff.astype(jnp.int32)) - 1
+    gid = jnp.zeros(n, jnp.int32).at[order].set(gid_sorted)
     return gid, order, diff
 
 
 def _dense_rank_pair(a: jnp.ndarray, b: jnp.ndarray):
-    """Joint dense rank of two arrays (values aligned across both)."""
+    """Joint dense rank of two arrays (values aligned across both).
+    Ranks are int32 (row counts are always < 2^31)."""
     both = jnp.concatenate([a, b])
-    order = jnp.argsort(both, stable=True)
-    s = both[order]
     n = both.shape[0]
-    diff = jnp.zeros(n, jnp.int64).at[0].set(0)
-    diff = diff.at[1:].set((s[1:] != s[:-1]).astype(jnp.int64))
+    iota = jax.lax.iota(jnp.int32, n)
+    s, order = jax.lax.sort((both, iota), num_keys=1, is_stable=True)
+    diff = jnp.zeros(n, jnp.int32).at[1:].set(
+        (s[1:] != s[:-1]).astype(jnp.int32))
     rank_sorted = jnp.cumsum(diff)
-    ranks = jnp.zeros(n, jnp.int64).at[order].set(rank_sorted)
+    ranks = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
     return ranks[:a.shape[0]], ranks[a.shape[0]:]
+
+
+def _narrow_span(c: DCol) -> Optional[Tuple[int, int]]:
+    """(lo, hi) when every valid value of ``c`` fits the int32 key
+    space (|v| < 2^30), else None.  Strings qualify via dictionary
+    size (codes are 0..len-1); int-like kinds need static bounds."""
+    if c.ctype.kind == "string":
+        nd = 0 if c.dictionary is None else len(c.dictionary)
+        return (0, max(nd - 1, 0)) if nd < _NARROW_LIM else None
+    if c.ctype.kind in ("int32", "int64", "date", "decimal") and \
+            c.bounds is not None:
+        lo, hi = c.bounds
+        if -_NARROW_LIM < lo and hi < _NARROW_LIM:
+            return (int(lo), int(hi))
+    return None
+
+
+def _key_col(c: DCol, alive: jnp.ndarray) -> jnp.ndarray:
+    """Single-table grouping/sort key in the narrowest dtype: int32
+    with int32 sentinels when the value domain fits, else the int64
+    (or float64) encoding of :func:`_key_i64`."""
+    if c.ctype.kind == "float64":
+        return _key_i64(c, alive)
+    if _narrow_span(c) is not None:
+        data = c.data.astype(jnp.int32)
+        data = jnp.where(c.valid, data, _NULL32)
+        return jnp.where(alive, data, _DEAD32)
+    return _key_i64(c, alive)
 
 
 # ---------------------------------------------------------------------------
@@ -1130,17 +1187,19 @@ class JaxExecutor:
     def _exec_limit(self, p: lp.Limit) -> DTable:
         dt = self.compact(self.execute(p.child))
         cap = dt.capacity
-        keep = jnp.arange(cap) < p.n
+        keep = jax.lax.iota(jnp.int32, cap) < min(p.n, cap)
         return DTable(dt.columns, dt.alive & keep)
 
     def compact(self, dt: DTable) -> DTable:
         """Scatter alive rows to the front (order-preserving); one
         sync point for the new capacity."""
         cap, n_alive = self._capacity_for(jnp.sum(dt.alive))
-        idx_src = jnp.nonzero(dt.alive, size=cap, fill_value=0)[0]
-        alive = jnp.arange(cap) < n_alive
+        idx_src = jnp.nonzero(dt.alive, size=cap,
+                              fill_value=0)[0].astype(jnp.int32)
+        alive = jax.lax.iota(jnp.int32, cap) < \
+            jnp.asarray(n_alive).astype(jnp.int32)
         cols = {n: DCol(c.data[idx_src], c.valid[idx_src] & alive,
-                        c.ctype, c.dictionary)
+                        c.ctype, c.dictionary, c.bounds)
                 for n, c in dt.columns.items()}
         return DTable(cols, alive)
 
@@ -1158,6 +1217,14 @@ class JaxExecutor:
                             -jnp.inf if nulls_first else jnp.inf)
             # dead rows strictly last
             return jnp.where(alive, key, jnp.inf)
+        if _narrow_span(c) is not None:
+            # int32 order key (dictionary codes already collate — the
+            # dictionaries are sorted)
+            data = c.data.astype(jnp.int32)
+            key = data if asc else -data
+            key = jnp.where(c.valid, key,
+                            _NULL32 if nulls_first else -_NULL32)
+            return jnp.where(alive, key, _ORD_DEAD32)
         data = c.data.astype(jnp.int64)
         key = data if asc else -data
         key = jnp.where(c.valid, key,
@@ -1189,9 +1256,13 @@ class JaxExecutor:
         cols: Dict[str, DCol] = {}
         for n in parts[0].column_names:
             cs = [t.columns[n] for t in parts]
+            bounds = None
+            if all(c.bounds is not None for c in cs):
+                bounds = (min(c.bounds[0] for c in cs),
+                          max(c.bounds[1] for c in cs))
             cols[n] = DCol(jnp.concatenate([c.data for c in cs]),
                            jnp.concatenate([c.valid for c in cs]),
-                           cs[0].ctype, cs[0].dictionary)
+                           cs[0].ctype, cs[0].dictionary, bounds)
         return DTable(cols, jnp.concatenate([t.alive for t in parts]))
 
     def _aggregate_once(self, dt: DTable, p: lp.Aggregate,
@@ -1215,12 +1286,13 @@ class JaxExecutor:
             gid, ngseg, out_alive, out_cols, order = direct
             use_pallas = self.groupby_mode == "pallas"
         elif key_cols:
-            keys = [_key_i64(c, dt.alive) for _, c in key_cols]
+            keys = [_key_col(c, dt.alive) for _, c in key_cols]
             gid, order, newgrp = _group_ids(keys)
             ngseg = cap
             # representative (first-in-sorted-order) row per group
-            first_pos = jnp.full(cap, cap, jnp.int64).at[
-                (jnp.cumsum(newgrp) - 1)].min(jnp.arange(cap))
+            first_pos = jnp.full(cap, cap, jnp.int32).at[
+                (jnp.cumsum(newgrp.astype(jnp.int32)) - 1)].min(
+                jax.lax.iota(jnp.int32, cap))
             rep = order[jnp.clip(first_pos, 0, cap - 1)]
             galive = jax.ops.segment_sum(
                 dt.alive.astype(jnp.int32), gid, num_segments=ngseg) > 0
@@ -1230,10 +1302,10 @@ class JaxExecutor:
             out_cols: Dict[str, DCol] = {}
             for name, c in key_cols:
                 out_cols[name] = DCol(c.data[rep], c.valid[rep] & out_alive,
-                                      c.ctype, c.dictionary)
+                                      c.ctype, c.dictionary, c.bounds)
         else:
-            gid = jnp.where(dt.alive, 0, 1).astype(jnp.int64)
-            order = jnp.argsort(gid, stable=True)
+            gid = jnp.where(dt.alive, 0, 1).astype(jnp.int32)
+            order = _lexsort_order([gid])
             ngseg = cap
             out_alive = jnp.zeros(cap, bool).at[0].set(True)
             out_cols = {}
@@ -1264,7 +1336,8 @@ class JaxExecutor:
         for _name, c in key_cols:
             if c.dictionary is not None and c.ctype.kind == "string":
                 lo, span = 0, len(c.dictionary)
-            elif c.bounds is not None and c.ctype.kind in ("int32", "int64"):
+            elif c.bounds is not None and c.ctype.kind in (
+                    "int32", "int64", "date", "decimal"):
                 lo, hi = c.bounds
                 span = hi - lo + 1
             else:
@@ -1272,13 +1345,19 @@ class JaxExecutor:
             if span <= 0:
                 return None
             domain *= span + 1
-            if domain > self.groupby_domain_cap:
+            if domain > self.groupby_domain_cap or domain >= 2 ** 31 - 1:
                 return None
             parts.append((c, lo, span))
         cap = int(alive.shape[0])
-        gid = jnp.zeros(cap, jnp.int64)
+        # the domain cap keeps the mixed-radix gid well inside int32
+        gid = jnp.zeros(cap, jnp.int32)
         for c, lo, span in parts:
-            idx = jnp.clip(c.data.astype(jnp.int64) - lo, 0, span - 1)
+            if -(2 ** 31) < lo and lo + span - 1 < 2 ** 31 and \
+                    c.data.dtype == jnp.int32:
+                idx = jnp.clip(c.data - np.int32(lo), 0, span - 1)
+            else:
+                idx = jnp.clip(c.data.astype(jnp.int64) - lo, 0,
+                               span - 1).astype(jnp.int32)
             idx = jnp.where(c.valid, idx, span)     # NULL slot per key
             gid = gid * (span + 1) + idx
         gid = jnp.where(alive, gid, domain)         # dead rows -> trash slot
@@ -1305,7 +1384,7 @@ class JaxExecutor:
 
         def order_thunk():
             if "o" not in memo:
-                memo["o"] = jnp.argsort(gid, stable=True)
+                memo["o"] = _lexsort_order([gid])
             return memo["o"]
 
         return gid, ngseg, out_alive, out_cols, order_thunk
@@ -1394,6 +1473,17 @@ class JaxExecutor:
             order = order()
         return df64.segment_sum_compensated(vals, gid, ngseg, order)
 
+    @staticmethod
+    def _segment_sum_float_pair(x1, x2, gid, ngseg, order):
+        """Two compensated float segment sums sharing ONE scan (one
+        sort-order gather, one associative scan with a doubled carry —
+        half the HLO of two independent scans; q39's stddev moments are
+        the hot caller)."""
+        from ndstpu.engine import df64
+        if callable(order):
+            order = order()
+        return df64.segment_sum_compensated2(x1, x2, gid, ngseg, order)
+
     def _pallas_interpret(self) -> bool:
         """Mosaic lowering only exists on real TPU backends; everywhere
         else (CPU tests, host-pinned discovery) run the interpreter."""
@@ -1429,9 +1519,12 @@ class JaxExecutor:
             # (group, value) pairs sort-side first
             return self._agg_distinct(dt, evl, a, gid, ngseg)
         if isinstance(a.arg, ex.Star):
-            counts = jax.ops.segment_sum(alive.astype(jnp.int64), gid,
+            # count in int32 (row capacities are < 2^31); widen only the
+            # group-capacity output to the INT64 result contract
+            counts = jax.ops.segment_sum(alive.astype(jnp.int32), gid,
                                          num_segments=ngseg)
-            return DCol(counts, jnp.ones(ngseg, bool), INT64)
+            return DCol(counts.astype(jnp.int64), jnp.ones(ngseg, bool),
+                        INT64)
         c = evl.eval(a.arg)
         valid = c.valid & alive
         if use_pallas and func in ("sum", "avg") and \
@@ -1450,10 +1543,11 @@ class JaxExecutor:
                 data = data / (10 ** c.ctype.scale)
             return DCol(data, cnts > 0, FLOAT64)
         if func == "count":
-            counts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+            counts = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
                                          num_segments=ngseg)
-            return DCol(counts, jnp.ones(ngseg, bool), INT64)
-        got = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+            return DCol(counts.astype(jnp.int64), jnp.ones(ngseg, bool),
+                        INT64)
+        got = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
                                   num_segments=ngseg) > 0
         if func == "sum":
             sums = self._segment_sum_typed(
@@ -1465,7 +1559,7 @@ class JaxExecutor:
                 return DCol(sums, got, INT64)
             return DCol(sums, got, FLOAT64)
         if func == "avg":
-            cnts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+            cnts = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
                                        num_segments=ngseg)
             sums = self._segment_sum_typed(
                 _sum_input(c.data, valid, c.ctype.kind), gid, ngseg,
@@ -1482,28 +1576,28 @@ class JaxExecutor:
                        else jax.ops.segment_max)
                 out = seg(vals, gid, num_segments=ngseg)
                 return DCol(out, got, c.ctype)
-            data64 = c.data.astype(jnp.int64)
-            init = _DEAD_KEY if func == "min" else -_DEAD_KEY
-            vals = jnp.where(valid, data64, init)
+            vals = _minmax_vals(c.data, valid, c.ctype.kind,
+                                func == "min")
             seg = (jax.ops.segment_min if func == "min"
                    else jax.ops.segment_max)
             out = seg(vals, gid, num_segments=ngseg)
-            return DCol(out.astype(c.data.dtype), got, c.ctype, c.dictionary)
+            return DCol(out.astype(c.data.dtype), got, c.ctype,
+                        c.dictionary, c.bounds)
         if func in ("stddev_samp", "var_samp", "stddev", "variance"):
             # shifted two-pass moments (see physical.py analog): center
             # by the group mean so E[x^2]-E[x]^2 cancellation cannot eat
             # the variance when mean >> stddev; the (sum d)^2/n term
-            # corrects the mean's own rounding.
+            # corrects the mean's own rounding.  d1/d2 ride ONE
+            # compensated scan (df64 pair carry) instead of two.
             x = evl.cast(c, FLOAT64).data
             xv = jnp.where(valid, x, 0.0)
-            cnt = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+            cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
                                       num_segments=ngseg)
             s1 = self._segment_sum_typed(xv, gid, ngseg, "float64", order)
             mean = s1 / jnp.maximum(cnt, 1)
             d = jnp.where(valid, x - mean[gid], 0.0)
-            d1 = self._segment_sum_typed(d, gid, ngseg, "float64", order)
-            d2 = self._segment_sum_typed(d * d, gid, ngseg, "float64",
-                                         order)
+            d1, d2 = self._segment_sum_float_pair(d, d * d, gid, ngseg,
+                                                  order)
             ok = cnt > 1
             denom = jnp.where(ok, cnt - 1, 1)
             var = jnp.maximum(
@@ -1520,18 +1614,19 @@ class JaxExecutor:
         func = a.func
         c = evl.eval(a.arg)
         valid = c.valid & dt.alive
-        vkey = _key_i64(c, dt.alive)
-        order = _lexsort_order([gid.astype(jnp.int64), vkey])
+        vkey = _key_col(c, dt.alive)
+        order = _lexsort_order([gid, vkey])
         gid_s = gid[order]
         vkey_s = vkey[order]
         cap = dt.capacity
         first = jnp.ones(cap, bool).at[1:].set(
             (gid_s[1:] != gid_s[:-1]) | (vkey_s[1:] != vkey_s[:-1]))
         uniq = first & valid[order]
-        cnts = jax.ops.segment_sum(uniq.astype(jnp.int64), gid_s,
+        cnts = jax.ops.segment_sum(uniq.astype(jnp.int32), gid_s,
                                    num_segments=ngseg)
         if func == "count":
-            return DCol(cnts, jnp.ones(ngseg, bool), INT64)
+            return DCol(cnts.astype(jnp.int64), jnp.ones(ngseg, bool),
+                        INT64)
         got = cnts > 0
         data_s = c.data[order]
         if c.ctype.kind in ("decimal", "int32", "int64"):
@@ -1568,24 +1663,24 @@ class JaxExecutor:
         if w.partition_by:
             pcols = [evl.eval(self._resolve_subqueries(e))
                      for e in w.partition_by]
-            pkeys = [_key_i64(c, dt.alive) for c in pcols]
+            pkeys = [_key_col(c, dt.alive) for c in pcols]
         else:
-            pkeys = [jnp.where(dt.alive, jnp.int64(0), _DEAD_KEY)]
+            pkeys = [jnp.where(dt.alive, 0, 1).astype(jnp.int32)]
         pid, _, _ = _group_ids(pkeys)
         okeys = []
         for e, asc in w.order_by:
             c = evl.eval(self._resolve_subqueries(e))
             okeys.append(self._order_key(evl, c, asc, None))
         if w.func in ("row_number", "rank", "dense_rank"):
-            order = _lexsort_order([pid.astype(jnp.int64)] + okeys)
-            idx = jnp.arange(cap)
+            order = _lexsort_order([pid] + okeys)
+            idx = jax.lax.iota(jnp.int32, cap)
             pid_s = pid[order]
             newpart = jnp.ones(cap, bool)
             if cap > 1:
                 newpart = newpart.at[1:].set(pid_s[1:] != pid_s[:-1])
             part_start = jax.lax.cummax(jnp.where(newpart, idx, 0))
             pos_in_part = idx - part_start
-            inv = jnp.zeros(cap, jnp.int64).at[order].set(idx)
+            inv = jnp.zeros(cap, jnp.int32).at[order].set(idx)
             if w.func == "row_number":
                 return DCol((pos_in_part + 1)[inv].astype(jnp.int64),
                             jnp.ones(cap, bool), INT64)
@@ -1600,7 +1695,7 @@ class JaxExecutor:
                 last_nontie = jax.lax.cummax(jnp.where(~tie, idx, 0))
                 ranks = pos_in_part[last_nontie] + 1
             else:
-                incr = jnp.where(newpart, 0, (~tie).astype(jnp.int64))
+                incr = jnp.where(newpart, 0, (~tie).astype(jnp.int32))
                 csum = jnp.cumsum(incr)
                 base = jax.lax.cummax(jnp.where(newpart, csum, 0))
                 ranks = csum - base + 1
@@ -1614,18 +1709,18 @@ class JaxExecutor:
         gid = pid
         if w.func == "count" and (w.arg is None or
                                   isinstance(w.arg, ex.Star)):
-            cnt = jax.ops.segment_sum(dt.alive.astype(jnp.int64), gid,
+            cnt = jax.ops.segment_sum(dt.alive.astype(jnp.int32), gid,
                                       num_segments=cap)
-            return DCol(cnt[gid], jnp.ones(cap, bool), INT64)
+            return DCol(cnt[gid].astype(jnp.int64), jnp.ones(cap, bool),
+                        INT64)
         arg = evl.eval(self._resolve_subqueries(w.arg))
         valid = arg.valid & dt.alive
-        cnts = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+        cnts = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
                                    num_segments=cap)
         got = (cnts > 0)[gid]
         if w.func == "count":
-            return DCol(jax.ops.segment_sum(
-                valid.astype(jnp.int64), gid, num_segments=cap)[gid],
-                jnp.ones(cap, bool), INT64)
+            return DCol(cnts[gid].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
         if w.func == "sum":
             tot = jax.ops.segment_sum(
                 _sum_input(arg.data, valid, arg.ctype.kind), gid,
@@ -1651,9 +1746,8 @@ class JaxExecutor:
                        else jax.ops.segment_max)
                 return DCol(seg(vals, gid, num_segments=cap)[gid], got,
                             arg.ctype)
-            data64 = arg.data.astype(jnp.int64)
-            init = _DEAD_KEY if w.func == "min" else -_DEAD_KEY
-            vals = jnp.where(valid, data64, init)
+            vals = _minmax_vals(arg.data, valid, arg.ctype.kind,
+                                w.func == "min")
             seg = (jax.ops.segment_min if w.func == "min"
                    else jax.ops.segment_max)
             out = seg(vals, gid, num_segments=cap)[gid]
@@ -1668,9 +1762,9 @@ class JaxExecutor:
         Sort by (partition, order keys), segmented cumulative combine,
         peers share the end-of-tie-run value under RANGE frames."""
         cap = dt.capacity
-        idx = jnp.arange(cap)
-        order = _lexsort_order([pid.astype(jnp.int64)] + okeys)
-        inv = jnp.zeros(cap, jnp.int64).at[order].set(idx)
+        idx = jax.lax.iota(jnp.int32, cap)
+        order = _lexsort_order([pid] + okeys)
+        inv = jnp.zeros(cap, jnp.int32).at[order].set(idx)
         pid_s = pid[order]
         newpart = jnp.ones(cap, bool).at[1:].set(pid_s[1:] != pid_s[:-1])
         pstart = jax.lax.cummax(jnp.where(newpart, idx, 0))
@@ -1693,15 +1787,17 @@ class JaxExecutor:
 
         alive_s = dt.alive[order]
         if w.arg is None or isinstance(w.arg, ex.Star):  # count(*)
-            run = seg_cumsum(alive_s.astype(jnp.int64))[run_end]
-            return DCol(run[inv], jnp.ones(cap, bool), INT64)
+            run = seg_cumsum(alive_s.astype(jnp.int32))[run_end]
+            return DCol(run[inv].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
         arg = evl.eval(self._resolve_subqueries(w.arg))
         valid_s = (arg.valid & dt.alive)[order]
         data_s = arg.data[order]
-        rcnt = seg_cumsum(valid_s.astype(jnp.int64))[run_end]
+        rcnt = seg_cumsum(valid_s.astype(jnp.int32))[run_end]
         got = (rcnt > 0)[inv]
         if w.func == "count":
-            return DCol(rcnt[inv], jnp.ones(cap, bool), INT64)
+            return DCol(rcnt[inv].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
         if w.func in ("sum", "avg"):
             run = seg_cumsum(
                 _sum_input(data_s, valid_s, arg.ctype.kind))[run_end]
@@ -1723,8 +1819,10 @@ class JaxExecutor:
                 sent = jnp.inf if is_min else -jnp.inf
                 x = jnp.where(valid_s, data_s, sent)
             else:
-                sent = _DEAD_KEY if is_min else -_DEAD_KEY
-                x = jnp.where(valid_s, data_s.astype(jnp.int64), sent)
+                x = _minmax_vals(data_s, valid_s, arg.ctype.kind, is_min)
+                sent = x.dtype.type(
+                    jnp.iinfo(x.dtype).max if is_min
+                    else jnp.iinfo(x.dtype).min)
             # doubling prefix scan clipped at partition starts
             out = x
             shift = 1
@@ -1751,17 +1849,19 @@ class JaxExecutor:
                                     "string", "bool", "float64"):
                 raise Unsupported("distinct column type")
         cap = dt.capacity
-        keys = [_key_i64(c, dt.alive) for c in dt.columns.values()]
+        keys = [_key_col(c, dt.alive) for c in dt.columns.values()]
         gid, order, newgrp = _group_ids(keys)
-        first_pos = jnp.full(cap, cap, jnp.int64).at[
-            (jnp.cumsum(newgrp) - 1)].min(jnp.arange(cap))
+        first_pos = jnp.full(cap, cap, jnp.int32).at[
+            (jnp.cumsum(newgrp.astype(jnp.int32)) - 1)].min(
+            jax.lax.iota(jnp.int32, cap))
         rep = order[jnp.clip(first_pos, 0, cap - 1)]
         slot_used = jnp.zeros(cap, bool).at[gid].set(True)
         galive = jax.ops.segment_sum(dt.alive.astype(jnp.int32), gid,
                                      num_segments=cap) > 0
         out_alive = slot_used & galive
         cols = {n: DCol(c.data[rep], c.valid[rep] & out_alive, c.ctype,
-                        c.dictionary) for n, c in dt.columns.items()}
+                        c.dictionary, c.bounds)
+                for n, c in dt.columns.items()}
         return DTable(cols, out_alive)
 
     # -- set ops -------------------------------------------------------------
@@ -1778,9 +1878,9 @@ class JaxExecutor:
         # left occurrence of each qualifying row-value group
         cap = both.capacity
         nl = lt.capacity
-        keys = [_key_i64(c, both.alive) for c in both.columns.values()]
+        keys = [_key_col(c, both.alive) for c in both.columns.values()]
         gid, order, newgrp = _group_ids(keys)
-        pos = jnp.arange(cap)
+        pos = jax.lax.iota(jnp.int32, cap)
         is_left = pos < nl
         in_left = jax.ops.segment_sum(
             (both.alive & is_left).astype(jnp.int32), gid,
@@ -1791,7 +1891,7 @@ class JaxExecutor:
         keepg = (in_left & in_right) if p.kind == "intersect" else \
             (in_left & ~in_right)
         lidx = jnp.where(both.alive & is_left, pos, cap)
-        firstl = jnp.full(cap, cap, jnp.int64).at[gid].min(lidx)
+        firstl = jnp.full(cap, cap, jnp.int32).at[gid].min(lidx)
         keep = (firstl[gid] == pos) & keepg[gid] & both.alive & is_left
         return DTable(both.columns, keep)
 
@@ -1817,48 +1917,155 @@ class JaxExecutor:
                     tgt = ex.common_type(lc.ctype, rc.ctype)
                     lc = JEval(lt).cast(lc, tgt)
                     rc = JEval(rt).cast(rc, tgt)
+                bounds = None
+                if lc.bounds is not None and rc.bounds is not None:
+                    bounds = (min(lc.bounds[0], rc.bounds[0]),
+                              max(lc.bounds[1], rc.bounds[1]))
                 cols[n] = DCol(
                     jnp.concatenate([lc.data, rc.data]),
-                    jnp.concatenate([lc.valid, rc.valid]), tgt)
+                    jnp.concatenate([lc.valid, rc.valid]), tgt, None,
+                    bounds)
         alive = jnp.concatenate([lt.alive, rt.alive])
         return DTable(cols, alive)
 
     # -- join ----------------------------------------------------------------
 
+    @staticmethod
+    def _direct_join_spec(lc: DCol, rc: DCol):
+        """Static (lo, span, lmult, rmult) when this key pair can be
+        encoded directly from values (no rank-pairing sort): int-like
+        kinds on both sides with known bounds, scales aligned by exact
+        host-side multipliers.  None -> rank-pair fallback."""
+        int_kinds = ("int32", "int64", "date", "decimal")
+        if lc.ctype.kind not in int_kinds or rc.ctype.kind not in int_kinds:
+            return None
+        if lc.bounds is None or rc.bounds is None:
+            return None
+        ls = lc.ctype.scale if lc.ctype.kind == "decimal" else 0
+        rs = rc.ctype.scale if rc.ctype.kind == "decimal" else 0
+        s = max(ls, rs)
+        lmult, rmult = 10 ** (s - ls), 10 ** (s - rs)
+        blo = min(lc.bounds[0] * lmult, rc.bounds[0] * rmult)
+        bhi = max(lc.bounds[1] * lmult, rc.bounds[1] * rmult)
+        span = bhi - blo + 1
+        if span >= 2 ** 62:
+            return None
+        return (blo, span, lmult, rmult)
+
+    @staticmethod
+    def _string_join_spec(lc: DCol, rc: DCol):
+        """Static (merged_dict_or_None, span) for a string key pair.
+        merged is None when both sides share one dictionary (codes used
+        as-is)."""
+        if lc.ctype.kind != "string" or rc.ctype.kind != "string":
+            return None
+        if lc.dictionary is not None and rc.dictionary is not None and \
+                len(lc.dictionary) == len(rc.dictionary) and \
+                np.array_equal(lc.dictionary, rc.dictionary):
+            return (None, max(len(lc.dictionary), 1))
+        merged = _merged_dict([lc, rc])
+        return (merged, max(len(merged), 1))
+
     def _join_keys(self, lt: DTable, rt: DTable,
                    keys: List[Tuple[ex.Expr, ex.Expr]]):
-        """Composite int64 join keys on both sides (mixed-radix over joint
-        dense ranks).  Raises Unsupported when radix could overflow."""
+        """Composite join keys on both sides (mixed-radix).
+
+        Key pairs whose value domain is statically known (int-like with
+        bounds, dictionary-coded strings) are encoded DIRECTLY from
+        values — no joint dense-rank, which costs a full sort over the
+        combined capacities per key.  Only unbounded pairs (raw float64,
+        computed columns without bounds) pay the rank-pairing sort.
+        When the final composite bound fits int32 the whole key build
+        runs in int32 (native on v5e; int64 is emulated as s32 pairs)."""
         levl, revl = JEval(lt), JEval(rt)
         lcols = [levl.eval(self._resolve_subqueries(le)) for le, _ in keys]
         rcols = [revl.eval(self._resolve_subqueries(re_)) for _, re_ in keys]
         capl, capr = lt.capacity, rt.capacity
-        radix = capl + capr + 3
-        lkey = jnp.zeros(capl, jnp.int64)
-        rkey = jnp.zeros(capr, jnp.int64)
+        rank_radix = capl + capr + 3
+        specs = []
+        for lc, rc in zip(lcols, rcols):
+            spec = self._direct_join_spec(lc, rc)
+            if spec is None and lc.ctype.kind == "string":
+                sspec = self._string_join_spec(lc, rc)
+                if sspec is not None:
+                    spec = ("str",) + sspec
+            specs.append(spec)
+        # simulate the radix accumulation host-side to pick the key dtype
+        bound = 1
+        redensified = False
+        for spec in specs:
+            if spec is None:
+                radix = rank_radix
+            elif spec[0] == "str":
+                radix = spec[2]
+            else:
+                radix = spec[1]
+            if bound * radix >= 2 ** 62:
+                redensified = True
+                bound = rank_radix
+            bound *= radix
+        use32 = (not redensified) and bound < 2 ** 31
+        kdt = jnp.int32 if use32 else jnp.int64
+        lkey = jnp.zeros(capl, kdt)
+        rkey = jnp.zeros(capr, kdt)
         lvalid = jnp.ones(capl, bool)
         rvalid = jnp.ones(capr, bool)
         bound = 1  # exclusive upper bound on current composite key values
-        for lc, rc in zip(lcols, rcols):
-            la = _key_i64(lc, lt.alive, peer=rc)
-            ra = _key_i64(rc, rt.alive, peer=lc)
-            # decimal/int alignment
-            if lc.ctype.kind == "decimal" or rc.ctype.kind == "decimal":
-                ls = lc.ctype.scale if lc.ctype.kind == "decimal" else 0
-                rs = rc.ctype.scale if rc.ctype.kind == "decimal" else 0
-                s = max(ls, rs)
-                la = jnp.where(jnp.abs(la) < _DEAD_KEY,
-                               la * (10 ** (s - ls)), la)
-                ra = jnp.where(jnp.abs(ra) < _DEAD_KEY,
-                               ra * (10 ** (s - rs)), ra)
-            lr, rr = _dense_rank_pair(la, ra)
+        for (lc, rc), spec in zip(zip(lcols, rcols), specs):
+            if spec is not None and spec[0] == "str":
+                _, merged, span = spec
+                la = _translate(lc, merged) if merged is not None \
+                    else lc.data
+                ra = _translate(rc, merged) if merged is not None \
+                    else rc.data
+                # invalid codes (<0) clip into range; those rows are
+                # overridden by the validity sentinels downstream
+                la = jnp.clip(la, 0, span - 1).astype(kdt)
+                ra = jnp.clip(ra, 0, span - 1).astype(kdt)
+                radix = span
+            elif spec is not None:
+                blo, span, lmult, rmult = spec
+                radix = span
+                # build in int32 only when the aligned value range fits;
+                # garbage (dead/invalid) rows may wrap — they are
+                # sentinel-overridden downstream
+                if use32 and -(2 ** 31) < blo and \
+                        blo + span - 1 < 2 ** 31:
+                    la = jnp.clip(lc.data.astype(jnp.int32) * lmult - blo,
+                                  0, span - 1)
+                    ra = jnp.clip(rc.data.astype(jnp.int32) * rmult - blo,
+                                  0, span - 1)
+                else:
+                    la = jnp.clip(lc.data.astype(jnp.int64) * lmult - blo,
+                                  0, span - 1).astype(kdt)
+                    ra = jnp.clip(rc.data.astype(jnp.int64) * rmult - blo,
+                                  0, span - 1).astype(kdt)
+            else:
+                if capl * capr > 2 ** 48:
+                    raise Unsupported("join too large for rank pairing")
+                la64 = _key_i64(lc, lt.alive, peer=rc)
+                ra64 = _key_i64(rc, rt.alive, peer=lc)
+                # decimal/int alignment (rank path only; direct path
+                # aligns via host multipliers)
+                if lc.ctype.kind == "decimal" or rc.ctype.kind == "decimal":
+                    ls = lc.ctype.scale if lc.ctype.kind == "decimal" else 0
+                    rs = rc.ctype.scale if rc.ctype.kind == "decimal" else 0
+                    s = max(ls, rs)
+                    la64 = jnp.where(jnp.abs(la64) < _DEAD_KEY,
+                                     la64 * (10 ** (s - ls)), la64)
+                    ra64 = jnp.where(jnp.abs(ra64) < _DEAD_KEY,
+                                     ra64 * (10 ** (s - rs)), ra64)
+                lr, rr = _dense_rank_pair(la64, ra64)
+                la, ra = lr.astype(kdt), rr.astype(kdt)
+                radix = rank_radix
             if bound * radix >= 2 ** 62:
                 # re-densify the accumulated composite so mixed-radix
                 # never overflows int64, however many join keys there are
                 lkey, rkey = _dense_rank_pair(lkey, rkey)
-                bound = radix
-            lkey = lkey * radix + lr
-            rkey = rkey * radix + rr
+                lkey, rkey = lkey.astype(kdt), rkey.astype(kdt)
+                bound = rank_radix
+            lkey = lkey * radix + la
+            rkey = rkey * radix + ra
             bound = bound * radix
             lvalid = lvalid & lc.valid
             rvalid = rvalid & rc.valid
@@ -1892,15 +2099,17 @@ class JaxExecutor:
         nl = jnp.sum(ltc.alive)
         nr = jnp.sum(rtc.alive)
         out_cap, total = self._capacity_for(nl * nr)
-        pos = jnp.arange(out_cap)
-        nr_safe = jnp.maximum(nr, 1)
+        pos = jax.lax.iota(jnp.int32, out_cap)
+        nr_safe = jnp.maximum(nr, 1).astype(jnp.int32)
         li = jnp.minimum(pos // nr_safe, ltc.capacity - 1)
         ri = jnp.minimum(pos % nr_safe, rtc.capacity - 1)
-        alive = pos < total
+        alive = pos < jnp.asarray(total).astype(jnp.int32)
         lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
-                         c.dictionary) for n, c in ltc.columns.items()}
+                         c.dictionary, c.bounds)
+                 for n, c in ltc.columns.items()}
         rcols = {n: DCol(c.data[ri], c.valid[ri] & alive, c.ctype,
-                         c.dictionary) for n, c in rtc.columns.items()}
+                         c.dictionary, c.bounds)
+                 for n, c in rtc.columns.items()}
         out = DTable({**lcols, **rcols}, alive)
         if extra is not None:
             mask = JEval(out).predicate(extra)
@@ -1912,23 +2121,23 @@ class JaxExecutor:
         # right rows with no key match (residual predicate excluded, as in
         # the reference interpreter's full-join path)
         lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
-        lkey = jnp.where(lvalid & lt.alive, lkey, jnp.int64(-1))
-        rkey = jnp.where(rvalid & rt.alive, rkey, jnp.int64(-2))
-        lorder = jnp.argsort(lkey, stable=True)
-        lsorted = lkey[lorder]
+        lkey = jnp.where(lvalid & lt.alive, lkey, -1)
+        rkey = jnp.where(rvalid & rt.alive, rkey, -2)
+        lsorted = jax.lax.sort(lkey)
         rmatched = jnp.searchsorted(lsorted, rkey, side="left") != \
             jnp.searchsorted(lsorted, rkey, side="right")
         runmatched = rt.alive & ~rmatched
         # bottom block: null left columns + unmatched right rows
         bottom_cols: Dict[str, DCol] = {}
         for n, c in lt.columns.items():
-            # null left columns sized to the bottom block's (right) capacity
+            # null left columns sized to the bottom block's (right)
+            # capacity; bounds stay sound (filler rows are all invalid)
             bottom_cols[n] = DCol(jnp.zeros(rt.capacity, c.data.dtype),
                                   jnp.zeros(rt.capacity, bool), c.ctype,
-                                  c.dictionary)
+                                  c.dictionary, c.bounds)
         for n, c in rt.columns.items():
             bottom_cols[n] = DCol(c.data, c.valid & runmatched, c.ctype,
-                                  c.dictionary)
+                                  c.dictionary, c.bounds)
         bottom = DTable(bottom_cols, runmatched)
         return self._vconcat(left_part, bottom)
 
@@ -1936,10 +2145,13 @@ class JaxExecutor:
                        extra) -> jnp.ndarray:
         """Per-left-row mask: does any key match survive the residual
         predicate?  (shared by semi / anti / mark joins)"""
-        out_cap, total = self._capacity_for(jnp.sum(counts))
+        out_cap, total = self._capacity_for(
+            jnp.sum(counts, dtype=jnp.int64))
         inner = self._expand(lt, rt, order, lo, counts, total, out_cap)
         keep = JEval(inner).predicate(extra)
-        li_all = jnp.searchsorted(jnp.cumsum(counts), jnp.arange(out_cap),
+        ccounts = jnp.cumsum(counts)
+        li_all = jnp.searchsorted(ccounts,
+                                  jax.lax.iota(ccounts.dtype, out_cap),
                                   side="right")
         li_all = jnp.clip(li_all, 0, lt.capacity - 1)
         return jax.ops.segment_sum(keep.astype(jnp.int32), li_all,
@@ -1947,8 +2159,6 @@ class JaxExecutor:
 
     def _equi_join(self, lt: DTable, rt: DTable, keys, kind,
                    extra, mark: Optional[str] = None) -> DTable:
-        if lt.capacity * rt.capacity > 2 ** 48:
-            raise Unsupported("join too large for rank pairing")
         lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
 
         if kind == "nullaware_anti":
@@ -1961,11 +2171,12 @@ class JaxExecutor:
                 lt = DTable(lt.columns, lt.alive & lvalid)
 
         # null keys never match; dead rows already sentineled apart
-        lkey = jnp.where(lvalid & lt.alive, lkey, jnp.int64(-1))
-        rkey = jnp.where(rvalid & rt.alive, rkey, jnp.int64(-2))
+        lkey = jnp.where(lvalid & lt.alive, lkey, -1)
+        rkey = jnp.where(rvalid & rt.alive, rkey, -2)
 
-        order = jnp.argsort(rkey, stable=True)
-        rsorted = rkey[order]
+        rsorted, order = jax.lax.sort(
+            (rkey, jax.lax.iota(jnp.int32, rt.capacity)), num_keys=1,
+            is_stable=True)
         lo = jnp.searchsorted(rsorted, lkey, side="left")
         hi = jnp.searchsorted(rsorted, lkey, side="right")
         counts = jnp.where(lt.alive, hi - lo, 0)
@@ -1994,7 +2205,8 @@ class JaxExecutor:
 
         # inner/left expansion: one sync point for output capacity
         if kind == "inner":
-            out_cap, total = self._capacity_for(jnp.sum(counts))
+            out_cap, total = self._capacity_for(
+                jnp.sum(counts, dtype=jnp.int64))
             out = self._expand(lt, rt, order, lo, counts, total, out_cap)
             if extra is not None:
                 mask = JEval(out).predicate(extra)
@@ -2007,27 +2219,32 @@ class JaxExecutor:
     def _expand(self, lt: DTable, rt: DTable, order, lo, counts,
                 total, out_cap: int) -> DTable:
         ccounts = jnp.cumsum(counts)
-        pos = jnp.arange(out_cap)
+        pos = jax.lax.iota(ccounts.dtype, out_cap)
         li = jnp.searchsorted(ccounts, pos, side="right")
         li = jnp.clip(li, 0, lt.capacity - 1)
         begin = ccounts[li] - counts[li]
-        within = pos - begin
+        within = (pos - begin).astype(lo.dtype)
         rpos = jnp.clip(lo[li] + within, 0, rt.capacity - 1)
         ri = order[rpos]
-        alive = pos < total
+        alive = pos < jnp.asarray(total).astype(pos.dtype)
         lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
-                         c.dictionary) for n, c in lt.columns.items()}
+                         c.dictionary, c.bounds)
+                 for n, c in lt.columns.items()}
         rcols = {n: DCol(c.data[ri], c.valid[ri] & alive, c.ctype,
-                         c.dictionary) for n, c in rt.columns.items()}
+                         c.dictionary, c.bounds)
+                 for n, c in rt.columns.items()}
         return DTable({**lcols, **rcols}, alive)
 
     def _left_join(self, lt: DTable, rt: DTable, order, lo, counts,
                    extra) -> DTable:
-        matched_cap, total = self._capacity_for(jnp.sum(counts))
+        matched_cap, total = self._capacity_for(
+            jnp.sum(counts, dtype=jnp.int64))
         inner = self._expand(lt, rt, order, lo, counts, total, matched_cap)
         # left-row index feeding each inner output position
-        li_all = jnp.searchsorted(jnp.cumsum(counts),
-                                  jnp.arange(matched_cap), side="right")
+        ccounts = jnp.cumsum(counts)
+        li_all = jnp.searchsorted(ccounts,
+                                  jax.lax.iota(ccounts.dtype, matched_cap),
+                                  side="right")
         li_all = jnp.clip(li_all, 0, lt.capacity - 1)
         if extra is not None:
             keep = JEval(inner).predicate(extra)
@@ -2037,15 +2254,16 @@ class JaxExecutor:
                                    num_segments=lt.capacity)
         unmatched_mask = lt.alive & (hits == 0)
         inner_c = self.compact(inner)
-        n_matched = jnp.sum(inner_c.alive)
-        n_unmatched = jnp.sum(unmatched_mask)
+        n_matched = jnp.sum(inner_c.alive, dtype=jnp.int32)
+        n_unmatched = jnp.sum(unmatched_mask, dtype=jnp.int32)
         out_cap, _ = self._capacity_for(n_matched + n_unmatched)
         # out[pos] = matched[pos] for pos < n_matched,
         #            unmatched-left[pos - n_matched] after (null right side)
-        pos = jnp.arange(out_cap)
+        pos = jax.lax.iota(jnp.int32, out_cap)
         is_m = pos < n_matched
         mi = jnp.clip(pos, 0, inner_c.capacity - 1)
-        um_idx = jnp.nonzero(unmatched_mask, size=out_cap, fill_value=0)[0]
+        um_idx = jnp.nonzero(unmatched_mask, size=out_cap,
+                             fill_value=0)[0].astype(jnp.int32)
         um_rows = um_idx[jnp.clip(pos - n_matched, 0, out_cap - 1)]
         out_alive = pos < (n_matched + n_unmatched)
         cols: Dict[str, DCol] = {}
@@ -2054,11 +2272,12 @@ class JaxExecutor:
             data = jnp.where(is_m, mc.data[mi], uc.data[um_rows])
             valid = jnp.where(is_m, mc.valid[mi], uc.valid[um_rows]) & \
                 out_alive
-            cols[n] = DCol(data, valid, mc.ctype, mc.dictionary)
+            cols[n] = DCol(data, valid, mc.ctype, mc.dictionary, uc.bounds)
         for n in rt.column_names:
             mc = inner_c.column(n)
             valid = jnp.where(is_m, mc.valid[mi], False) & out_alive
-            cols[n] = DCol(mc.data[mi], valid, mc.ctype, mc.dictionary)
+            cols[n] = DCol(mc.data[mi], valid, mc.ctype, mc.dictionary,
+                           mc.bounds)
         return DTable(cols, out_alive)
 
 

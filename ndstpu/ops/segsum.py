@@ -150,6 +150,10 @@ def segment_sum_decimal(vals: jnp.ndarray, gid: jnp.ndarray,
         raise ValueError("segment_sum_decimal: chunk rows above the "
                          "int32 accumulator bound")
     bias = jnp.int64(1) << _BIAS_BITS
+    # enforce the documented |value| < 2^41 bound: an out-of-range input
+    # would silently wrap in the limb planes; poison every sum with an
+    # unmistakable sentinel instead so validation flags it immediately
+    oob = jnp.any(mask & ((vals <= -bias) | (vals >= bias)))
     v = jnp.where(mask, vals.astype(jnp.int64) + bias, jnp.int64(0))
     g = jnp.where(mask, gid.astype(jnp.int32), jnp.int32(-1))
     v = _pad_to(v, block_rows)
@@ -183,4 +187,5 @@ def segment_sum_decimal(vals: jnp.ndarray, gid: jnp.ndarray,
     for k in range(_N_LIMBS):
         sums = sums + (out[k] << (_LIMB_BITS * k))
     sums = sums - counts * (jnp.int64(1) << _BIAS_BITS)
+    sums = jnp.where(oob, jnp.int64(-(2 ** 62)), sums)
     return sums, counts
