@@ -224,7 +224,8 @@ class Unsupported(Exception):
 
 def _civil_from_days(days: jnp.ndarray):
     """days since 1970-01-01 -> (year, month, day), integer math only
-    (int32 throughout: |days| < 2^21 for any representable date)."""
+    (int32 throughout: safe while |days| + 719468 < 2^31, i.e. any
+    date the engine can represent)."""
     z = days.astype(jnp.int32) + 719468
     era = jnp.floor_divide(z, 146097)
     doe = z - era * 146097
@@ -838,9 +839,8 @@ def _key_i64(c: DCol, alive: jnp.ndarray,
     return jnp.where(alive, data, _DEAD_KEY)
 
 
-def _lexsort_order(keys: List[jnp.ndarray],
-                   stable: bool = True) -> jnp.ndarray:
-    """argsort by multiple keys; keys[0] is the primary.
+def _lexsort_order(keys: List[jnp.ndarray]) -> jnp.ndarray:
+    """Stable argsort by multiple keys; keys[0] is the primary.
 
     ONE variadic ``lax.sort`` (num_keys=len(keys)) with an int32 iota
     payload — not a chain of per-key argsorts: a single sort HLO on TPU
@@ -959,6 +959,10 @@ class JaxExecutor:
         self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "auto")
         self.groupby_domain_cap = int(
             _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 16)))
+        # LUT-join domain cap: counts/starts tables of `bound` slots live
+        # in HBM (2 x 4B x bound; 1<<25 -> 256 MB peak, freed per join)
+        self.join_lut_cap = int(
+            _os.environ.get("NDSTPU_JOIN_LUT_CAP", str(1 << 25)))
 
     # -- public --------------------------------------------------------------
 
@@ -2069,7 +2073,101 @@ class JaxExecutor:
             bound = bound * radix
             lvalid = lvalid & lc.valid
             rvalid = rvalid & rc.valid
-        return lkey, rkey, lvalid, rvalid
+        return lkey, rkey, lvalid, rvalid, bound
+
+    def _probe_counts(self, pkey: jnp.ndarray, bkey: jnp.ndarray,
+                      bound: int, need_order: bool = True):
+        """Per-probe-row (lo, counts) against the build side, plus the
+        build-side stable key order: ``order[lo[i] .. lo[i]+counts[i]-1]``
+        are the build rows matching probe row ``i``.
+
+        NO ``searchsorted``: on TPU its binary-search lowering costs one
+        4M-index gather per iteration (~0.5-0.7 s per call measured on
+        v5e at SF1 — scripts/prim_bench.py).  Instead:
+
+        * ``bound <= _LUT_CAP``: direct-addressed lookup tables.  Build
+          counts via one scatter-add over the key domain, starts via one
+          cumsum, probe via two gathers.  (The composite join key bound
+          is statically known — _join_keys tracks it — so this is the
+          common case: surrogate-key joins are dense small domains.)
+        * otherwise: ONE variadic sort of concat(build, probe) tagged by
+          side; in sorted order, builds-before = prefix count, the run
+          start carries lo, and unique-destination scatters route
+          lo/counts back to probe positions and build ranks to `order`.
+
+        Probe rows with key < 0 (sentinels) never match; build rows with
+        key < 0 never enter the tables but DO occupy `order` slots (they
+        sort first), matching the old sort+searchsorted layout.
+        """
+        m = int(bkey.shape[0])
+        n = int(pkey.shape[0])
+        iota_m = jax.lax.iota(jnp.int32, m)
+        # LUT only when the domain is within both the absolute cap and a
+        # small multiple of the table sizes: its cumsum/memset run over
+        # `bound` slots, so a near-cap domain against tiny tables would
+        # cost far more than the sort path over m+n rows
+        if bound is not None and 0 < bound <= min(
+                self.join_lut_cap, max(8 * (m + n), 1 << 20)):
+            span = int(bound)
+            bidx = jnp.where(bkey >= 0, bkey, span).astype(jnp.int32)
+            cnt_t = jnp.zeros(span + 1, jnp.int32).at[bidx].add(1)
+            cnt = cnt_t[:span]
+            ccnt = jnp.cumsum(cnt)
+            # valid build keys sort AFTER the (<0) sentinel rows in the
+            # stable key order, so starts are offset by the dead count
+            n_dead = jnp.sum((bkey < 0).astype(jnp.int32))
+            starts = ccnt - cnt + n_dead
+            pk = jnp.clip(pkey, 0, span - 1).astype(jnp.int32)
+            hit = pkey >= 0
+            counts = jnp.where(hit, cnt[pk], 0)
+            lo = starts[pk].astype(jnp.int32)
+            order = None
+            if need_order:
+                # dead build rows (key < 0) sort FIRST, matching the
+                # `starts` offset by n_dead above
+                okey = jnp.where(bkey >= 0, bkey, -1).astype(jnp.int32)
+                order = jax.lax.sort((okey, iota_m), num_keys=1,
+                                     is_stable=True)[1]
+            return lo, counts, order
+        key = jnp.concatenate([bkey, pkey])
+        tag = (jax.lax.iota(jnp.int32, m + n) >= m).astype(jnp.int32)
+        idx = jax.lax.iota(jnp.int32, m + n)
+        skey, stag, sidx = jax.lax.sort((key, tag, idx), num_keys=2,
+                                        is_stable=True)
+        isb = (stag == 0).astype(jnp.int32)
+        builds_le = jnp.cumsum(isb)               # builds at pos <= s
+        before = builds_le - isb                  # builds strictly before s
+        newrun = jnp.ones(m + n, bool).at[1:].set(skey[1:] != skey[:-1])
+        # `before` is non-decreasing, so cummax propagates each run
+        # start's value (builds with key < run key) across the run
+        lo_sorted = jax.lax.cummax(jnp.where(newrun, before, 0))
+        cnt_sorted = builds_le - lo_sorted        # builds in run up to s
+        dest = jnp.where(stag == 1, sidx - m, n)  # build rows -> trash slot
+        lo = jnp.zeros(n + 1, jnp.int32).at[dest].set(lo_sorted)[:n]
+        counts = jnp.zeros(n + 1, jnp.int32).at[dest].set(cnt_sorted)[:n]
+        counts = jnp.where(pkey >= 0, counts, 0)
+        order = None
+        if need_order:
+            bdest = jnp.where(isb == 1, builds_le - 1, m)
+            order = jnp.zeros(m + 1, jnp.int32).at[bdest].set(sidx)[:m]
+        return lo, counts, order
+
+    @staticmethod
+    def _expand_li(counts: jnp.ndarray, starts: jnp.ndarray,
+                   out_cap: int) -> jnp.ndarray:
+        """Left-row index feeding each expansion output position.
+
+        Replaces ``searchsorted(cumsum(counts), pos)``: scatter each
+        emitting row's id at its start position, cummax fills the run.
+        Starts of emitting rows are strictly increasing, so destinations
+        are unique."""
+        cap = int(counts.shape[0])
+        emit = counts > 0
+        sdest = jnp.where(emit, starts, out_cap)
+        rid = jnp.where(emit, jax.lax.iota(jnp.int32, cap) + 1, 0)
+        tmp = jnp.zeros(out_cap + 1, jnp.int32).at[sdest].max(rid)
+        li = jax.lax.cummax(tmp[:out_cap]) - 1
+        return jnp.clip(li, 0, cap - 1)
 
     def _exec_join(self, p: lp.Join) -> DTable:
         kind = p.kind
@@ -2120,13 +2218,12 @@ class JaxExecutor:
         left_part = self._equi_join(lt, rt, keys, "left", extra)
         # right rows with no key match (residual predicate excluded, as in
         # the reference interpreter's full-join path)
-        lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
+        lkey, rkey, lvalid, rvalid, bound = self._join_keys(lt, rt, keys)
         lkey = jnp.where(lvalid & lt.alive, lkey, -1)
         rkey = jnp.where(rvalid & rt.alive, rkey, -2)
-        lsorted = jax.lax.sort(lkey)
-        rmatched = jnp.searchsorted(lsorted, rkey, side="left") != \
-            jnp.searchsorted(lsorted, rkey, side="right")
-        runmatched = rt.alive & ~rmatched
+        _, rcounts, _ = self._probe_counts(rkey, lkey, bound,
+                                           need_order=False)
+        runmatched = rt.alive & ~(rcounts > 0)
         # bottom block: null left columns + unmatched right rows
         bottom_cols: Dict[str, DCol] = {}
         for n, c in lt.columns.items():
@@ -2149,17 +2246,14 @@ class JaxExecutor:
             jnp.sum(counts, dtype=jnp.int64))
         inner = self._expand(lt, rt, order, lo, counts, total, out_cap)
         keep = JEval(inner).predicate(extra)
-        ccounts = jnp.cumsum(counts)
-        li_all = jnp.searchsorted(ccounts,
-                                  jax.lax.iota(ccounts.dtype, out_cap),
-                                  side="right")
-        li_all = jnp.clip(li_all, 0, lt.capacity - 1)
+        starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+        li_all = self._expand_li(counts, starts, out_cap)
         return jax.ops.segment_sum(keep.astype(jnp.int32), li_all,
                                    num_segments=lt.capacity) > 0
 
     def _equi_join(self, lt: DTable, rt: DTable, keys, kind,
                    extra, mark: Optional[str] = None) -> DTable:
-        lkey, rkey, lvalid, rvalid = self._join_keys(lt, rt, keys)
+        lkey, rkey, lvalid, rvalid, bound = self._join_keys(lt, rt, keys)
 
         if kind == "nullaware_anti":
             rt_has_null = self._branch_bool(jnp.any(~rvalid & rt.alive))
@@ -2174,12 +2268,10 @@ class JaxExecutor:
         lkey = jnp.where(lvalid & lt.alive, lkey, -1)
         rkey = jnp.where(rvalid & rt.alive, rkey, -2)
 
-        rsorted, order = jax.lax.sort(
-            (rkey, jax.lax.iota(jnp.int32, rt.capacity)), num_keys=1,
-            is_stable=True)
-        lo = jnp.searchsorted(rsorted, lkey, side="left")
-        hi = jnp.searchsorted(rsorted, lkey, side="right")
-        counts = jnp.where(lt.alive, hi - lo, 0)
+        need_order = kind in ("inner", "left") or extra is not None
+        lo, counts, order = self._probe_counts(lkey, rkey, bound,
+                                               need_order=need_order)
+        counts = jnp.where(lt.alive, counts, 0)
         matched = counts > 0
 
         if kind == "mark":
@@ -2218,15 +2310,13 @@ class JaxExecutor:
 
     def _expand(self, lt: DTable, rt: DTable, order, lo, counts,
                 total, out_cap: int) -> DTable:
-        ccounts = jnp.cumsum(counts)
-        pos = jax.lax.iota(ccounts.dtype, out_cap)
-        li = jnp.searchsorted(ccounts, pos, side="right")
-        li = jnp.clip(li, 0, lt.capacity - 1)
-        begin = ccounts[li] - counts[li]
-        within = (pos - begin).astype(lo.dtype)
+        starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+        pos = jax.lax.iota(jnp.int32, out_cap)
+        li = self._expand_li(counts, starts, out_cap)
+        within = (pos - starts[li]).astype(lo.dtype)
         rpos = jnp.clip(lo[li] + within, 0, rt.capacity - 1)
         ri = order[rpos]
-        alive = pos < jnp.asarray(total).astype(pos.dtype)
+        alive = pos < jnp.asarray(total).astype(jnp.int32)
         lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
                          c.dictionary, c.bounds)
                  for n, c in lt.columns.items()}
@@ -2241,11 +2331,8 @@ class JaxExecutor:
             jnp.sum(counts, dtype=jnp.int64))
         inner = self._expand(lt, rt, order, lo, counts, total, matched_cap)
         # left-row index feeding each inner output position
-        ccounts = jnp.cumsum(counts)
-        li_all = jnp.searchsorted(ccounts,
-                                  jax.lax.iota(ccounts.dtype, matched_cap),
-                                  side="right")
-        li_all = jnp.clip(li_all, 0, lt.capacity - 1)
+        starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+        li_all = self._expand_li(counts, starts, matched_cap)
         if extra is not None:
             keep = JEval(inner).predicate(extra)
             inner = DTable(inner.columns, keep)

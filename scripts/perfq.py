@@ -1,0 +1,80 @@
+"""Per-query TPU perf probe: compile time, steady-state time, HLO op mix.
+
+    python scripts/perfq.py query1 query3 query6
+    python scripts/perfq.py --hlo query6        # also dump op histogram
+
+Uses the bench warehouse (.bench_cache/wh_sf1) and the persistent XLA
+cache, so numbers match what bench.py will see.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import pathlib
+import re
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("names", nargs="+")
+    ap.add_argument("--sf", default="1")
+    ap.add_argument("--hlo", action="store_true",
+                    help="dump StableHLO op histogram per part")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the persistent XLA compile cache")
+    args = ap.parse_args()
+
+    import jax
+    if not args.no_cache:
+        jax.config.update("jax_compilation_cache_dir",
+                          str(REPO / ".bench_cache" / "xla_cache_tpu"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.queries import streamgen
+
+    wh = str(REPO / ".bench_cache" / f"wh_sf{args.sf}")
+    sess = Session(loader.load_catalog(wh), backend="tpu")
+
+    for name in args.names:
+        tpl = name if name.endswith(".tpl") else name + ".tpl"
+        parts = streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0)
+        for pname, sql in parts:
+            t0 = time.time()
+            out = sess.sql(sql)
+            out.to_rows()
+            t_first = time.time() - t0
+            steadies = []
+            for _ in range(args.reps - 1):
+                t0 = time.time()
+                out = sess.sql(sql)
+                out.to_rows()
+                steadies.append(time.time() - t0)
+            steady = min(steadies) if steadies else float("nan")
+            cp = sess.compiled_plan(sql)
+            mode = "jit" if (cp is not None and cp.compilable) else "EAGER"
+            print(f"{pname:16s} {mode:5s} first={t_first:7.2f}s "
+                  f"steady={steady:7.3f}s rows={out.num_rows}",
+                  flush=True)
+            if args.hlo and cp is not None and cp.fn is not None:
+                exe = sess._jax_executor()
+                targs = {t: exe._accel_args(t, cols)
+                         for t, cols in cp.table_cols.items()}
+                txt = cp.fn.lower(targs).as_text()
+                ops = collections.Counter(
+                    re.findall(r"stablehlo\.(\w+)", txt))
+                total = sum(ops.values())
+                top = ", ".join(f"{k}:{v}" for k, v in ops.most_common(18))
+                print(f"  ops={total}  {top}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

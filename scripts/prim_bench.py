@@ -1,0 +1,165 @@
+"""Primitive microbench on the live chip, tunnel-overhead-corrected.
+
+The axon tunnel costs ~0.1 s per dispatched program, so single-op
+timings are meaningless.  Each case is measured as ONE jitted program
+chaining the op k times with a data dependency (defeats CSE/DCE), for
+k in {1, 9}; per-op cost = (t9 - t1) / 8.
+
+    python scripts/prim_bench.py [--n 4194304]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run_chain(build, k, reps=4):
+    f = jax.jit(lambda *xs: build(k, *xs))
+
+    def once(args):
+        o = f(*args)
+        leaf = jax.tree_util.tree_leaves(o)[0]
+        _ = np.asarray(leaf.ravel()[0])
+    return f
+
+
+def timeit(build, args, k, reps=4):
+    f = jax.jit(lambda *xs: build(k, *xs))
+    o = f(*args)  # compile
+    _ = np.asarray(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        o = f(*args)
+        _ = np.asarray(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1 << 22)
+    ap.add_argument("--segs", type=int, default=1024)
+    args = ap.parse_args()
+    n, nseg = args.n, args.segs
+    rng = np.random.default_rng(0)
+    perm32 = jnp.asarray(rng.permutation(n).astype(np.int32))
+    i32 = jnp.asarray(rng.integers(0, 1 << 20, n).astype(np.int32))
+    i64 = i32.astype(jnp.int64)
+    f32 = jnp.asarray(rng.random(n).astype(np.float32))
+    f64 = f32.astype(jnp.float64)
+    seg = jnp.asarray(rng.integers(0, nseg, n).astype(np.int32))
+
+    # each builder: (k, *arrays) -> output, chaining k data-dependent ops
+    def ew(k, a):
+        for _ in range(k):
+            a = a * 2 + 1
+        return a
+
+    def gather(k, a, p):
+        for _ in range(k):
+            a = a[p]
+        return a
+
+    def scat_set(k, a, p):
+        for _ in range(k):
+            a = jnp.zeros_like(a).at[p].set(a)
+        return a
+
+    def segsum(k, a, s):
+        acc = jnp.zeros((nseg,), a.dtype)
+        for _ in range(k):
+            out = jax.ops.segment_sum(a, s, num_segments=nseg)
+            acc = acc + out
+            a = a + 1
+        return acc
+
+    def segsum_n(k, a, p):
+        acc = jnp.zeros_like(a)
+        for _ in range(k):
+            out = jax.ops.segment_sum(a, p, num_segments=a.shape[0])
+            acc = acc + out
+            a = a + 1
+        return acc
+
+    def sort1(k, a):
+        for i in range(k):
+            a = jax.lax.sort(a + i)
+        return a
+
+    def sortpair(k, a):
+        io = jax.lax.iota(jnp.int32, a.shape[0])
+        for i in range(k):
+            a, io = jax.lax.sort((a + i, io), num_keys=1, is_stable=True)
+        return a + io
+
+    def sortpair5(k, a):
+        io = jax.lax.iota(jnp.int32, a.shape[0])
+        ps = [io + j for j in range(4)]
+        for i in range(k):
+            res = jax.lax.sort((a + i,) + tuple(ps), num_keys=1,
+                               is_stable=True)
+            a, ps = res[0], list(res[1:])
+        for p in ps:
+            a = a + p
+        return a
+
+    def csum(k, a):
+        for _ in range(k):
+            a = jnp.cumsum(a) % (1 << 20)
+        return a
+
+    def ssearch(k, a, b):
+        s = jax.lax.sort(a)
+        acc = jnp.zeros_like(b)
+        for i in range(k):
+            acc = acc + jnp.searchsorted(s, b + i)
+        return acc
+
+    def onehot_mm(k, vals, s):
+        # segment sum as (segs x rows_tile) one-hot matmuls, f32
+        acc = jnp.zeros((nseg,), jnp.float32)
+        for i in range(k):
+            oh = (s[None, :] == jnp.arange(nseg, dtype=jnp.int32)[:, None])
+            acc = acc + oh.astype(jnp.float32) @ vals
+            vals = vals + 1
+        return acc
+
+    cases = [
+        ("elementwise_i32", ew, (i32,)),
+        ("elementwise_i64", ew, (i64,)),
+        ("elementwise_f64", ew, (f64,)),
+        ("gather_perm_i32", gather, (i32, perm32)),
+        ("gather_perm_f64", gather, (f64, perm32)),
+        ("scatter_set_perm_i32", scat_set, (i32, perm32)),
+        (f"segsum_{nseg}_i32", segsum, (i32, seg)),
+        (f"segsum_{nseg}_f32", segsum, (f32, seg)),
+        (f"segsum_{nseg}_i64", segsum, (i64, seg)),
+        ("segsum_nseg=n_i32", segsum_n, (i32, perm32)),
+        ("sort_i32", sort1, (i32,)),
+        ("sort_i64", sort1, (i64,)),
+        ("sort_pair_i32", sortpair, (i32,)),
+        ("sort_pair_i32_4pay", sortpair5, (i32,)),
+        ("cumsum_i32", csum, (i32,)),
+        ("searchsorted_i32", ssearch, (jax.lax.sort(i32), i32)),
+    ]
+    if nseg <= 4096:
+        cases.append((f"onehot_mm_{nseg}_f32", onehot_mm, (f32, seg)))
+    print(f"n = {n}, segs = {nseg}")
+    for name, build, xs in cases:
+        try:
+            t1 = timeit(build, xs, 1)
+            t9 = timeit(build, xs, 9)
+            per = (t9 - t1) / 8
+            print(f"{name:24s} per-op={per*1e3:8.2f} ms   "
+                  f"(t1={t1*1e3:7.1f} t9={t9*1e3:7.1f})", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:24s} FAIL {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
