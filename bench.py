@@ -166,7 +166,11 @@ def _ensure_warehouse() -> str:
     tag = f"sf{SF:g}"
     raw = os.path.join(CACHE, f"raw_{tag}")
     wh = os.path.join(CACHE, f"wh_{tag}")
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # append, don't clobber: the host env may carry a sitecustomize dir
+    # (e.g. the axon PJRT plugin registration) on PYTHONPATH
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               PYTHONPATH=f"{REPO}{os.pathsep}{pp}" if pp else REPO)
     for d in (raw + "_tmp_", wh + "_tmp_"):   # stale partials from kills
         shutil.rmtree(d, ignore_errors=True)
     phase_limit = max(60.0, min(_remaining() - 300.0, 900.0))
@@ -219,8 +223,11 @@ def _run_one(sess, sql: str, slot: dict) -> None:
 
 
 def _power_run(sess, queries, times: dict, failed: list,
-               stop_at: float) -> bool:
-    """Run the stream serially; returns True iff every query ran."""
+               stop_at: float, rebuild=None) -> bool:
+    """Run the stream serially; returns True iff every query ran.
+    ``rebuild()`` (accel runs) returns a FRESH session after a hang, so
+    the abandoned zombie thread keeps only the old session's state and
+    cannot race the rest of the stream."""
     import threading
     accel = sess.backend != "cpu"
     hangs = 0
@@ -239,11 +246,6 @@ def _power_run(sess, queries, times: dict, failed: list,
                 if waited < QUERY_TIMEOUT_S:
                     # deadline cut an ordinary query, not a hang
                     return False
-                # Known tradeoff: the zombie thread stays blocked inside
-                # its jax call on the shared session; continuing risks a
-                # rare completion-time race, but aborting here would cap
-                # coverage at the first wedged program — and any crash
-                # still emits the partial JSON via the signal handlers.
                 print(f"BENCH-ERROR {name}: hang (> "
                       f"{QUERY_TIMEOUT_S:.0f}s), abandoned",
                       file=sys.stderr, flush=True)
@@ -253,6 +255,16 @@ def _power_run(sess, queries, times: dict, failed: list,
                     print("BENCH-WARNING: repeated hangs, aborting run",
                           file=sys.stderr, flush=True)
                     return False
+                if rebuild is not None:
+                    # the zombie thread stays blocked inside its jax
+                    # call — on the OLD session; a fresh one isolates
+                    # the remaining stream from any late completion
+                    try:
+                        sess = rebuild()
+                    except Exception as e:  # noqa: BLE001
+                        print(f"BENCH-WARNING: session rebuild failed "
+                              f"({e}); continuing on shared session",
+                              file=sys.stderr, flush=True)
                 continue
         else:
             _run_one(sess, sql, slot)
@@ -316,12 +328,22 @@ def main() -> None:
               file=sys.stderr, flush=True)
 
     STATE["phase"] = "tpu-runs"
-    tpu_sess = Session(catalog, backend="tpu")
     rec_path = os.path.join(CACHE, f"plans_sf{SF:g}.pkl")
-    try:  # persisted size-plan records: run 1 skips eager discovery
-        tpu_sess.preload_compiled(rec_path)
-    except Exception:
-        pass  # stale/corrupt records: discovery path still works
+
+    def make_tpu_sess():
+        s = Session(catalog, backend="tpu")
+        try:  # persisted size-plan records: skip eager discovery
+            s.preload_compiled(rec_path)
+        except Exception:
+            pass  # stale/corrupt records: discovery path still works
+        return s
+
+    holder = {"s": make_tpu_sess()}
+
+    def rebuild():
+        holder["s"] = make_tpu_sess()
+        return holder["s"]
+
     n_runs = int(os.environ.get("NDSTPU_BENCH_RUNS", "3"))
     # run1 = discovery/compile (+persistent-cache replay), later runs =
     # compiled replay — the steady-state number.  Every run honors the
@@ -332,10 +354,10 @@ def main() -> None:
         run = {"times": {}, "failed": [], "complete": False}
         STATE["tpu_runs"].append(run)
         run["complete"] = _power_run(
-            tpu_sess, queries, run["times"], run["failed"],
-            DEADLINE - 60.0)
+            holder["s"], queries, run["times"], run["failed"],
+            DEADLINE - 60.0, rebuild=rebuild)
         try:  # persist incrementally: a later crash must not lose them
-            tpu_sess.save_compiled(rec_path)
+            holder["s"].save_compiled(rec_path)
         except Exception:
             pass
         if not run["complete"]:
@@ -358,8 +380,8 @@ def main() -> None:
             STATE["phase"] = "tpu-steady-subset"
             run = {"times": {}, "failed": [], "complete": False}
             STATE["tpu_runs"].append(run)
-            _power_run(tpu_sess, done, run["times"], run["failed"],
-                       DEADLINE - 20.0)
+            _power_run(holder["s"], done, run["times"], run["failed"],
+                       DEADLINE - 20.0, rebuild=rebuild)
 
     STATE["phase"] = "done"
     _emit()

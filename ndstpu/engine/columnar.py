@@ -133,33 +133,48 @@ class Column:
 
     def to_pylist(self) -> List:
         """Decode to python values (None for nulls) — used by validation,
-        output writing and tests, not by the hot path."""
+        output writing and the result materialization that power-run
+        timing wraps (the `collect()` analog), so it is numpy-vectorized:
+        the old per-element loop cost 1-2 s on a 100k-row result."""
         v = self.validity()
-        out: List = []
         k = self.ctype.kind
+        data = self.data
         if k == "string":
             d = self.dictionary
-            for i, code in enumerate(self.data):
-                out.append(str(d[code]) if v[i] and code >= 0 else None)
+            good = v & (data >= 0)
+            if d is None or not len(d):
+                obj = np.full(len(data), None, dtype=object)
+            else:
+                # dictionary entries are python str by construction
+                obj = d[np.clip(data, 0, len(d) - 1)].astype(object)
         elif k == "decimal":
             scale = 10 ** self.ctype.scale
-            for i, x in enumerate(self.data):
-                out.append(int(x) / scale if v[i] else None)
+            obj = (data.astype(np.float64) / scale).astype(object)
+            # f64 can't hold >=2^53 unscaled values exactly; match the
+            # exact int/int division semantics for those rare rows
+            big = np.abs(data) >= (1 << 53)
+            if big.any():
+                for i in np.nonzero(big)[0]:
+                    obj[i] = int(data[i]) / scale
+            good = v
         elif k == "date":
-            base = np.datetime64("1970-01-01")
-            for i, x in enumerate(self.data):
-                out.append(str(base + np.timedelta64(int(x), "D"))
-                           if v[i] else None)
+            days = data.astype("timedelta64[D]") + \
+                np.datetime64("1970-01-01")
+            obj = days.astype("datetime64[D]").astype(str).astype(object)
+            good = v
         elif k == "bool":
-            for i, x in enumerate(self.data):
-                out.append(bool(x) if v[i] else None)
+            obj = data.astype(bool).astype(object)
+            good = v
         elif k in ("int32", "int64"):
-            for i, x in enumerate(self.data):
-                out.append(int(x) if v[i] else None)
+            obj = data.astype(np.int64).astype(object)
+            good = v
         else:
-            for i, x in enumerate(self.data):
-                out.append(float(x) if v[i] else None)
-        return out
+            obj = data.astype(np.float64).astype(object)
+            good = v
+        if not good.all():
+            obj = obj.copy() if obj.base is not None else obj
+            obj[~good] = None
+        return obj.tolist()
 
     def gather(self, indices: np.ndarray,
                extra_valid: Optional[np.ndarray] = None) -> "Column":

@@ -29,7 +29,7 @@ is bit-identical across backends by construction.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 
@@ -73,39 +73,47 @@ def ds_to_f64(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
     return hi.astype(jnp.float64) + lo.astype(jnp.float64)
 
 
-def segment_sum_ds_multi(xs, gid_sorted: jnp.ndarray, num_segments: int):
+def segment_sum_ds_multi(xs, gid_sorted: jnp.ndarray, num_segments: int,
+                         levels: Optional[int] = None):
     """Compensated per-segment sums of N value streams over ONE shared
-    segmented scan (the (hi, lo) carry widens per stream; scan overhead
-    is paid once).
+    Hillis-Steele segmented scan.
 
     Each ``xs[i]`` holds float64 values in sorted-segment order (invalid
     rows must be zeroed); ``gid_sorted`` the matching non-decreasing
     segment ids.  Returns a list of per-segment (hi, lo) f32 pairs;
     combine with :func:`ds_to_f64` (host-side for full effect).
+
+    ``levels`` bounds the longest segment run: after ``levels`` doubling
+    steps every position's prefix covers ``2**levels`` rows, so segments
+    no longer than that are complete.  Callers with a recorded run-length
+    bound (jaxexec discovery) pass it to emit ~15 full-width ops per
+    level — ``lax.associative_scan`` at fact capacities emitted a
+    program the TPU compiler never returned from (the q39 wedge), and
+    scanned ALL log2(n) levels regardless of segment sizes.
     """
-    n = xs[0].shape[0]
+    n = int(xs[0].shape[0])
     k = len(xs)
     if n == 0:
         z = jnp.zeros(num_segments, jnp.float32)
         return [(z, z)] * k
+    if levels is None:
+        levels = max(0, (n - 1).bit_length())
     pairs = [ds_from_f64(x) for x in xs]
-
-    def combine(a, b):
-        ga, gb = a[0], b[0]
-        same = ga == gb
-        out = [gb]
+    his = [p[0] for p in pairs]
+    los = [p[1] for p in pairs]
+    g = gid_sorted.astype(jnp.int32)
+    shift = 1
+    for _ in range(levels):
+        if shift >= n:
+            break
+        # x[i] (+)= x[i - shift] when both sit in the same segment:
+        # inclusive segmented prefix-scan, compensated at every add
+        same = jnp.zeros(n, bool).at[shift:].set(g[shift:] == g[:-shift])
         for i in range(k):
-            ah, al = a[1 + 2 * i], a[2 + 2 * i]
-            bh, bl = b[1 + 2 * i], b[2 + 2 * i]
-            nh, nl = ds_add(jnp.where(same, ah, 0.0),
-                            jnp.where(same, al, 0.0), bh, bl)
-            out += [nh, nl]
-        return tuple(out)
-
-    carry = (gid_sorted.astype(jnp.int32),) + tuple(
-        p for pair in pairs for p in pair)
-    res = lax.associative_scan(combine, carry)
-    g = res[0]
+            sh = jnp.where(same, jnp.roll(his[i], shift), 0.0)
+            sl = jnp.where(same, jnp.roll(los[i], shift), 0.0)
+            his[i], los[i] = ds_add(sh, sl, his[i], los[i])
+        shift *= 2
     # segment totals sit at each segment's last row; scatter-add so the
     # non-last rows (adding 0.0) can never clobber a total the way a
     # duplicate-index scatter-set could
@@ -114,37 +122,37 @@ def segment_sum_ds_multi(xs, gid_sorted: jnp.ndarray, num_segments: int):
     zero = jnp.zeros(num_segments, jnp.float32)
     out = []
     for i in range(k):
-        sh, sl = res[1 + 2 * i], res[2 + 2 * i]
-        out.append((zero.at[seg].add(jnp.where(last, sh, 0.0)),
-                    zero.at[seg].add(jnp.where(last, sl, 0.0))))
+        out.append((zero.at[seg].add(jnp.where(last, his[i], 0.0)),
+                    zero.at[seg].add(jnp.where(last, los[i], 0.0))))
     return out
 
 
 def segment_sum_ds(x: jnp.ndarray, gid_sorted: jnp.ndarray,
-                   num_segments: int
+                   num_segments: int, levels: Optional[int] = None
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Compensated per-segment sum over rows pre-sorted by segment id
     (single-stream wrapper over :func:`segment_sum_ds_multi`)."""
-    return segment_sum_ds_multi([x], gid_sorted, num_segments)[0]
+    return segment_sum_ds_multi([x], gid_sorted, num_segments, levels)[0]
 
 
 def segment_sum_compensated(x: jnp.ndarray, gid: jnp.ndarray,
-                            num_segments: int,
-                            order: jnp.ndarray) -> jnp.ndarray:
+                            num_segments: int, order: jnp.ndarray,
+                            levels: Optional[int] = None) -> jnp.ndarray:
     """Drop-in for ``jax.ops.segment_sum`` on float64 data with an
     available sort order (``gid[order]`` non-decreasing).  Returns f64
     per-segment sums accumulated at ~2^-48 instead of f32 drift."""
-    hi, lo = segment_sum_ds(x[order], gid[order], num_segments)
+    hi, lo = segment_sum_ds(x[order], gid[order], num_segments, levels)
     return ds_to_f64(hi, lo)
 
 
 def segment_sum_compensated2(x1: jnp.ndarray, x2: jnp.ndarray,
                              gid: jnp.ndarray, num_segments: int,
-                             order: jnp.ndarray):
+                             order: jnp.ndarray,
+                             levels: Optional[int] = None):
     """Two compensated segment sums over the SAME segmentation in ONE
-    associative scan (doubled (hi, lo) carry).  Halves the scan HLO for
-    callers that need paired moments (stddev's d and d^2)."""
+    scan (doubled (hi, lo) carry): half the HLO of two independent
+    scans for callers needing paired moments (stddev's d and d^2)."""
     gs = gid[order]
     (h1, l1), (h2, l2) = segment_sum_ds_multi(
-        [x1[order], x2[order]], gs, num_segments)
+        [x1[order], x2[order]], gs, num_segments, levels)
     return ds_to_f64(h1, l1), ds_to_f64(h2, l2)

@@ -101,23 +101,170 @@ def jnp_dtype(ct: DType):
 
 
 @dataclasses.dataclass
-class DCol:
-    """Device column: padded data + validity (meaningful where alive)."""
+class _View:
+    """Shared row indirection for lazily-gathered columns: ``idx`` maps
+    the current capacity into a BASE column's capacity (always one
+    level — compositions fold into a single gather), ``mask`` is an
+    accumulated validity-kill at the current capacity (or None)."""
 
-    data: jnp.ndarray
-    valid: jnp.ndarray          # bool, same capacity
-    ctype: DType
-    dictionary: Optional[np.ndarray] = None   # host-side, sorted
-    # host-side static (lo, hi) over the column's valid values, set at
-    # upload and preserved by row-subset ops (gather/filter); lets
-    # group-by linearize small integer key domains without sorting.
-    # Invalidation rides the same contract as `dictionary`: data changes
-    # bump the catalog version, which forces re-upload + re-trace.
-    bounds: Optional[Tuple[int, int]] = None
+    idx: jnp.ndarray
+    mask: Optional[jnp.ndarray] = None
+
+
+class DCol:
+    """Device column: padded data + validity (meaningful where alive).
+
+    Either materialized (``data``/``valid`` arrays) or a lazy view over
+    a base column (``src_data``/``src_valid`` + shared :class:`_View`).
+    Lazy columns materialize on first ``.data``/``.valid`` access with
+    ONE gather from the base — a 4M-row gather costs ~30 ms on v5e
+    (scripts/prim_bench.py), and eager join expansion re-gathered every
+    column of both sides at every join of a multi-join pipeline."""
+
+    __slots__ = ("_data", "_valid", "ctype", "dictionary", "bounds",
+                 "src_data", "src_valid", "view")
+
+    def __init__(self, data, valid, ctype: DType,
+                 dictionary: Optional[np.ndarray] = None,
+                 bounds: Optional[Tuple[int, int]] = None):
+        self._data = data
+        self._valid = valid
+        self.ctype = ctype
+        # host-side, sorted dictionary for string columns
+        self.dictionary = dictionary
+        # host-side static (lo, hi) over the column's valid values, set
+        # at upload and preserved by row-subset ops (gather/filter);
+        # lets group-by linearize small integer key domains without
+        # sorting.  Invalidation rides the same contract as
+        # `dictionary`: data changes bump the catalog version, which
+        # forces re-upload + re-trace.
+        self.bounds = bounds
+        self.src_data = None
+        self.src_valid = None
+        self.view = None
+
+    @classmethod
+    def lazy(cls, src_data, src_valid, view: _View, ctype: DType,
+             dictionary=None, bounds=None) -> "DCol":
+        c = cls(None, None, ctype, dictionary, bounds)
+        c.src_data = src_data
+        c.src_valid = src_valid
+        c.view = view
+        return c
+
+    @property
+    def data(self):
+        if self._data is None:
+            self._data = self.src_data[self.view.idx]
+        return self._data
+
+    @property
+    def valid(self):
+        if self._valid is None:
+            v = self.view.mask
+            if self.src_valid is not None:
+                sv = self.src_valid[self.view.idx]
+                v = sv if v is None else (sv & v)
+            if v is None:
+                v = jnp.ones(self.view.idx.shape[0], bool)
+            self._valid = v
+        return self._valid
 
     @property
     def capacity(self) -> int:
-        return int(self.data.shape[0])
+        if self._data is not None:
+            return int(self._data.shape[0])
+        return int(self.view.idx.shape[0])
+
+
+def _select_cols(cols_a: Dict[str, DCol], cols_b: Dict[str, DCol],
+                 idx_a: jnp.ndarray, idx_b: jnp.ndarray,
+                 pick_a: jnp.ndarray,
+                 extra_mask: Optional[jnp.ndarray] = None
+                 ) -> Dict[str, DCol]:
+    """Two-source row select: out[n][p] = a[n][idx_a[p]] if pick_a[p]
+    else b[n][idx_b[p]].  When both columns resolve to the SAME base
+    array (a is a lazy view of b's source — the left-join shape), the
+    select collapses to ONE combined index and stays lazy; otherwise
+    both sides materialize and combine with `where`."""
+    memo: Dict[tuple, _View] = {}
+    out: Dict[str, DCol] = {}
+    ones_a = None
+    for n in cols_a:
+        a, b = cols_a[n], cols_b[n]
+        base_a = a.src_data if a.view is not None else a._data
+        base_b = b.src_data if b.view is not None else b._data
+        if base_a is base_b:
+            key = (id(a.view), id(b.view))
+            v2 = memo.get(key)
+            if v2 is None:
+                ia = a.view.idx[idx_a] if a.view is not None else idx_a
+                ib = b.view.idx[idx_b] if b.view is not None else idx_b
+                nidx = jnp.where(pick_a, ia, ib)
+                ma = a.view.mask[idx_a] \
+                    if a.view is not None and a.view.mask is not None \
+                    else None
+                mb = b.view.mask[idx_b] \
+                    if b.view is not None and b.view.mask is not None \
+                    else None
+                if ma is None and mb is None:
+                    nmask = None
+                else:
+                    if ones_a is None:
+                        ones_a = jnp.ones(pick_a.shape[0], bool)
+                    nmask = jnp.where(pick_a,
+                                      ma if ma is not None else ones_a,
+                                      mb if mb is not None else ones_a)
+                if extra_mask is not None:
+                    nmask = extra_mask if nmask is None else \
+                        (nmask & extra_mask)
+                v2 = memo[key] = _View(nidx, nmask)
+            sv = a.src_valid if a.view is not None else a._valid
+            out[n] = DCol.lazy(base_a, sv, v2, a.ctype, a.dictionary,
+                               _union_bounds(a.bounds, b.bounds))
+        else:
+            data = jnp.where(pick_a, a.data[idx_a], b.data[idx_b])
+            valid = jnp.where(pick_a, a.valid[idx_a], b.valid[idx_b])
+            if extra_mask is not None:
+                valid = valid & extra_mask
+            out[n] = DCol(data, valid, a.ctype, a.dictionary,
+                          _union_bounds(a.bounds, b.bounds))
+    return out
+
+
+def _union_bounds(a: Optional[Tuple[int, int]],
+                  b: Optional[Tuple[int, int]]):
+    if a is None or b is None:
+        return None
+    return (min(a[0], b[0]), max(a[1], b[1]))
+
+
+def _gather_cols(cols: Dict[str, DCol], idx: jnp.ndarray,
+                 extra_mask: Optional[jnp.ndarray] = None
+                 ) -> Dict[str, DCol]:
+    """Lazily gather every column by ``idx``: columns sharing a view
+    compose index/mask ONCE; materialized sources just wrap.  With
+    ``extra_mask`` the gathered validity is additionally ANDed (at the
+    output capacity)."""
+    ident = _View(idx, extra_mask)
+    memo: Dict[int, _View] = {}
+    out: Dict[str, DCol] = {}
+    for n, c in cols.items():
+        if c.view is None:
+            out[n] = DCol.lazy(c._data, c._valid, ident, c.ctype,
+                               c.dictionary, c.bounds)
+            continue
+        v2 = memo.get(id(c.view))
+        if v2 is None:
+            nidx = c.view.idx[idx]
+            nmask = c.view.mask[idx] if c.view.mask is not None else None
+            if extra_mask is not None:
+                nmask = extra_mask if nmask is None else \
+                    (nmask & extra_mask)
+            v2 = memo[id(c.view)] = _View(nidx, nmask)
+        out[n] = DCol.lazy(c.src_data, c.src_valid, v2, c.ctype,
+                           c.dictionary, c.bounds)
+    return out
 
 
 @dataclasses.dataclass
@@ -142,10 +289,7 @@ class DTable:
         return DTable({n: self.columns[n] for n in names}, self.alive)
 
     def gather(self, idx: jnp.ndarray, alive: jnp.ndarray) -> "DTable":
-        cols = {n: DCol(c.data[idx], c.valid[idx], c.ctype, c.dictionary,
-                        c.bounds)
-                for n, c in self.columns.items()}
-        return DTable(cols, alive)
+        return DTable(_gather_cols(self.columns, idx), alive)
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +360,43 @@ def to_host(dt: DTable) -> Table:
 # ---------------------------------------------------------------------------
 # jnp expression evaluation (device mirror of ex.Evaluator)
 # ---------------------------------------------------------------------------
+
+
+def _plan_fp(o, out: Optional[list] = None) -> Optional[str]:
+    """Structural fingerprint of a plan/expression tree.
+
+    Unlike ``repr``, covers EVERY dataclass field (Scan's repr hides its
+    pruned columns and pushed-down predicate; Literal's hides its ctype)
+    and never folds two different inline tables together (keyed by object
+    identity — content comparison could false-match on numpy's elided
+    reprs)."""
+    top = out is None
+    if top:
+        out = []
+    if isinstance(o, lp.InlineTable):
+        out.append(f"IT{id(o.table)}")
+    elif dataclasses.is_dataclass(o) and not isinstance(o, type):
+        out.append(type(o).__name__)
+        out.append("(")
+        for f in dataclasses.fields(o):
+            _plan_fp(getattr(o, f.name), out)
+            out.append(",")
+        out.append(")")
+    elif isinstance(o, (list, tuple)):
+        out.append("[")
+        for x in o:
+            _plan_fp(x, out)
+            out.append(",")
+        out.append("]")
+    elif isinstance(o, np.ndarray):
+        # repr() elides long arrays ("...") — fingerprint the bytes
+        import zlib
+        out.append(f"ND{o.dtype}{o.shape}{zlib.crc32(o.tobytes())}")
+    else:
+        out.append(repr(o))
+    if top:
+        return "".join(out)
+    return None
 
 
 class Unsupported(Exception):
@@ -852,6 +1033,13 @@ def _lexsort_order(keys: List[jnp.ndarray]) -> jnp.ndarray:
                         is_stable=True)[-1]
 
 
+def _inv_permute(order: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """out[order[i]] = vals[i] for a permutation `order`: a pair-sort
+    keyed by the permutation (~9 ms at 4M on v5e) instead of a scatter
+    (~29 ms) — scripts/prim_bench.py."""
+    return jax.lax.sort((order, vals), num_keys=1, is_stable=True)[1]
+
+
 def _group_ids(keys: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray,
                                                  jnp.ndarray]:
     """Dense group ids via ONE variadic sort: (gid int32, order int32,
@@ -866,7 +1054,7 @@ def _group_ids(keys: List[jnp.ndarray]) -> Tuple[jnp.ndarray, jnp.ndarray,
     for ks in res[:-1]:
         diff = diff.at[1:].set(diff[1:] | (ks[1:] != ks[:-1]))
     gid_sorted = jnp.cumsum(diff.astype(jnp.int32)) - 1
-    gid = jnp.zeros(n, jnp.int32).at[order].set(gid_sorted)
+    gid = _inv_permute(order, gid_sorted)
     return gid, order, diff
 
 
@@ -880,7 +1068,7 @@ def _dense_rank_pair(a: jnp.ndarray, b: jnp.ndarray):
     diff = jnp.zeros(n, jnp.int32).at[1:].set(
         (s[1:] != s[:-1]).astype(jnp.int32))
     rank_sorted = jnp.cumsum(diff)
-    ranks = jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+    ranks = _inv_permute(order, rank_sorted)
     return ranks[:a.shape[0]], ranks[a.shape[0]:]
 
 
@@ -950,6 +1138,11 @@ class JaxExecutor:
         self._used_fallback = False
         # compiled-query cache: plan identity -> _CompiledPlan
         self._compiled: Dict[int, "_CompiledPlan"] = {}
+        # segmented compilation: fingerprint -> segment _CompiledPlan,
+        # shared across queries; eager segment results for the plan
+        # currently being discovered / eager-executed
+        self._seg_compiled: Dict[str, "_CompiledPlan"] = {}
+        self._seg_tables: Dict[str, DTable] = {}
         # group-by strategy: "sort" = lexsort dense-rank only; "auto" =
         # linearized gid when the key domain is small (skips the sort);
         # "pallas" = auto + one-hot MXU segment sums for exact
@@ -969,6 +1162,7 @@ class JaxExecutor:
     def execute_to_host(self, p: lp.Plan) -> Table:
         # per-query subquery memo: expr ids are only stable within one plan
         self._subq_cache = {}
+        self._tree_cache = {}
         self.np_exec = physical.Executor(self.catalog)
         self.mode = "eager"
         with host_compute():
@@ -1010,7 +1204,30 @@ class JaxExecutor:
             self._rec.append(("bool", b))
         return b
 
+    # expensive nodes worth structural-dedup: repeated CTE instances are
+    # deep-copied by the planner (copy_plan) so identity can't match, but
+    # instances the optimizer left identical (same pushed-down filters /
+    # pruned columns) fingerprint equal and execute ONCE per query.
+    # Deterministic given the plan tree, so discover and replay hit the
+    # memo at the same points and the size-plan record stays aligned.
+    _MEMO_NODES = (lp.Join, lp.Aggregate, lp.SetOp, lp.Window,
+                   lp.Distinct, lp.Sort)
+
     def execute(self, p: lp.Plan) -> DTable:
+        if isinstance(p, self._MEMO_NODES):
+            key = _plan_fp(p)
+            cache = getattr(self, "_tree_cache", None)
+            if cache is None:
+                cache = self._tree_cache = {}
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+            out = self._execute_node(p)
+            cache[key] = out
+            return out
+        return self._execute_node(p)
+
+    def _execute_node(self, p: lp.Plan) -> DTable:
         name = "_exec_" + type(p).__name__.lower()
         m = getattr(self, name, None)
         if m is None:
@@ -1077,6 +1294,12 @@ class JaxExecutor:
             # doesn't make the main plan non-compilable either)
             outer = self.mode
             outer_fallback = self._used_fallback
+            # isolate the subtree memo: a main-plan subtree must never
+            # hit a DTable cached during subquery resolution — replay
+            # skips subqueries entirely, so such a hit would desync the
+            # size-plan record positions between discover and replay
+            outer_tree = getattr(self, "_tree_cache", None)
+            self._tree_cache = {}
             self.mode = "eager"
             try:
                 t = to_host(self.execute(e.plan))
@@ -1105,6 +1328,8 @@ class JaxExecutor:
             finally:
                 self.mode = outer
                 self._used_fallback = outer_fallback
+                self._tree_cache = outer_tree if outer_tree is not None \
+                    else {}
             if self.mode == "discover":
                 self._rec.append(("subq", out))
             self._subq_cache[id(e)] = out
@@ -1165,6 +1390,14 @@ class JaxExecutor:
     def _exec_inlinetable(self, p: lp.InlineTable) -> DTable:
         return to_device(p.table)
 
+    def _exec_deviceresult(self, p: lp.DeviceResult) -> DTable:
+        """Separately-compiled segment result (segmented compilation):
+        replay reads the parent program's argument; eager/discover read
+        the eager segment tables staged by the orchestrator."""
+        if self.mode == "replay":
+            return self._trace_tables[_seg_argname(p.key)]
+        return self._seg_tables[p.key]
+
     def _exec_subqueryalias(self, p: lp.SubqueryAlias) -> DTable:
         dt = self.execute(p.child)
         if p.column_aliases:
@@ -1202,10 +1435,7 @@ class JaxExecutor:
                               fill_value=0)[0].astype(jnp.int32)
         alive = jax.lax.iota(jnp.int32, cap) < \
             jnp.asarray(n_alive).astype(jnp.int32)
-        cols = {n: DCol(c.data[idx_src], c.valid[idx_src] & alive,
-                        c.ctype, c.dictionary, c.bounds)
-                for n, c in dt.columns.items()}
-        return DTable(cols, alive)
+        return DTable(_gather_cols(dt.columns, idx_src, alive), alive)
 
     # -- sort ----------------------------------------------------------------
 
@@ -1303,10 +1533,10 @@ class JaxExecutor:
             # group table alive mask: one slot per distinct gid
             n_groups_mask = jnp.zeros(cap, bool).at[gid].set(True)
             out_alive = n_groups_mask & galive
-            out_cols: Dict[str, DCol] = {}
-            for name, c in key_cols:
-                out_cols[name] = DCol(c.data[rep], c.valid[rep] & out_alive,
-                                      c.ctype, c.dictionary, c.bounds)
+            # lazy: the final output compaction composes these rep
+            # gathers down to the compacted capacity (8 string group
+            # keys at 4M cost ~0.5 s in eager gathers otherwise)
+            out_cols = _gather_cols(dict(key_cols), rep, out_alive)
         else:
             gid = jnp.where(dt.alive, 0, 1).astype(jnp.int32)
             order = _lexsort_order([gid])
@@ -1355,16 +1585,27 @@ class JaxExecutor:
         cap = int(alive.shape[0])
         # the domain cap keeps the mixed-radix gid well inside int32
         gid = jnp.zeros(cap, jnp.int32)
+        # bounds-invariant guard: a valid value outside its static
+        # bounds means a DCol constructor copied bounds across a
+        # value-changing transform — route the row to the trash slot
+        # (visibly dropped) instead of silently merging it into the
+        # boundary group
+        row_ok = jnp.ones(cap, bool)
         for c, lo, span in parts:
             if -(2 ** 31) < lo and lo + span - 1 < 2 ** 31 and \
                     c.data.dtype == jnp.int32:
-                idx = jnp.clip(c.data - np.int32(lo), 0, span - 1)
+                raw = c.data - np.int32(lo)
+                row_ok = row_ok & (~c.valid | ((raw >= 0) & (raw < span)))
+                idx = jnp.clip(raw, 0, span - 1)
             else:
-                idx = jnp.clip(c.data.astype(jnp.int64) - lo, 0,
-                               span - 1).astype(jnp.int32)
+                raw64 = c.data.astype(jnp.int64) - lo
+                row_ok = row_ok & (~c.valid |
+                                   ((raw64 >= 0) & (raw64 < span)))
+                idx = jnp.clip(raw64, 0, span - 1).astype(jnp.int32)
             idx = jnp.where(c.valid, idx, span)     # NULL slot per key
             gid = gid * (span + 1) + idx
-        gid = jnp.where(alive, gid, domain)         # dead rows -> trash slot
+        # dead / bounds-violating rows -> trash slot
+        gid = jnp.where(alive & row_ok, gid, domain)
         ngseg = domain + 1
         counts = jax.ops.segment_sum(alive.astype(jnp.int32), gid,
                                      num_segments=ngseg)
@@ -1463,8 +1704,21 @@ class JaxExecutor:
             return JEval(gtable).eval(lowered)
         raise Unsupported(f"aggregate output {type(e).__name__}")
 
-    @staticmethod
-    def _segment_sum_typed(vals, gid, ngseg, kind: str, order):
+    def _scan_levels(self, gid, order) -> int:
+        """Recorded bound on the compensated scan's doubling steps: the
+        longest same-gid run (in sorted order), size-classed through
+        ``_capacity_for`` so replay gets a STATIC level count plus a
+        data-changed guard.  Typical group-bys need 8 levels, not the
+        log2(capacity)=22+ an unconditional full scan pays."""
+        gs = gid[order]
+        n = int(gs.shape[0])
+        pos = jax.lax.iota(jnp.int32, n)
+        newrun = jnp.ones(n, bool).at[1:].set(gs[1:] != gs[:-1])
+        runstart = jax.lax.cummax(jnp.where(newrun, pos, 0))
+        cap, _ = self._capacity_for(jnp.max(pos - runstart) + 1)
+        return max(0, int(cap).bit_length() - 1)
+
+    def _segment_sum_typed(self, vals, gid, ngseg, kind: str, order):
         """int/decimal sums stay exact s64 segment_sum; float sums use
         the compensated segmented scan (TPU computes f64 at f32
         precision — ndstpu.engine.df64).  `order` may be a lazy thunk
@@ -1475,18 +1729,20 @@ class JaxExecutor:
         from ndstpu.engine import df64
         if callable(order):
             order = order()
-        return df64.segment_sum_compensated(vals, gid, ngseg, order)
+        levels = self._scan_levels(gid, order)
+        return df64.segment_sum_compensated(vals, gid, ngseg, order,
+                                            levels)
 
-    @staticmethod
-    def _segment_sum_float_pair(x1, x2, gid, ngseg, order):
+    def _segment_sum_float_pair(self, x1, x2, gid, ngseg, order):
         """Two compensated float segment sums sharing ONE scan (one
-        sort-order gather, one associative scan with a doubled carry —
-        half the HLO of two independent scans; q39's stddev moments are
-        the hot caller)."""
+        sort-order gather, one doubled-carry scan — half the HLO of two
+        independent scans; q39's stddev moments are the hot caller)."""
         from ndstpu.engine import df64
         if callable(order):
             order = order()
-        return df64.segment_sum_compensated2(x1, x2, gid, ngseg, order)
+        levels = self._scan_levels(gid, order)
+        return df64.segment_sum_compensated2(x1, x2, gid, ngseg, order,
+                                             levels)
 
     def _pallas_interpret(self) -> bool:
         """Mosaic lowering only exists on real TPU backends; everywhere
@@ -1863,10 +2119,7 @@ class JaxExecutor:
         galive = jax.ops.segment_sum(dt.alive.astype(jnp.int32), gid,
                                      num_segments=cap) > 0
         out_alive = slot_used & galive
-        cols = {n: DCol(c.data[rep], c.valid[rep] & out_alive, c.ctype,
-                        c.dictionary, c.bounds)
-                for n, c in dt.columns.items()}
-        return DTable(cols, out_alive)
+        return DTable(_gather_cols(dt.columns, rep, out_alive), out_alive)
 
     # -- set ops -------------------------------------------------------------
 
@@ -2202,12 +2455,8 @@ class JaxExecutor:
         li = jnp.minimum(pos // nr_safe, ltc.capacity - 1)
         ri = jnp.minimum(pos % nr_safe, rtc.capacity - 1)
         alive = pos < jnp.asarray(total).astype(jnp.int32)
-        lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
-                         c.dictionary, c.bounds)
-                 for n, c in ltc.columns.items()}
-        rcols = {n: DCol(c.data[ri], c.valid[ri] & alive, c.ctype,
-                         c.dictionary, c.bounds)
-                 for n, c in rtc.columns.items()}
+        lcols = _gather_cols(ltc.columns, li, alive)
+        rcols = _gather_cols(rtc.columns, ri, alive)
         out = DTable({**lcols, **rcols}, alive)
         if extra is not None:
             mask = JEval(out).predicate(extra)
@@ -2317,12 +2566,8 @@ class JaxExecutor:
         rpos = jnp.clip(lo[li] + within, 0, rt.capacity - 1)
         ri = order[rpos]
         alive = pos < jnp.asarray(total).astype(jnp.int32)
-        lcols = {n: DCol(c.data[li], c.valid[li] & alive, c.ctype,
-                         c.dictionary, c.bounds)
-                 for n, c in lt.columns.items()}
-        rcols = {n: DCol(c.data[ri], c.valid[ri] & alive, c.ctype,
-                         c.dictionary, c.bounds)
-                 for n, c in rt.columns.items()}
+        lcols = _gather_cols(lt.columns, li, alive)
+        rcols = _gather_cols(rt.columns, ri, alive)
         return DTable({**lcols, **rcols}, alive)
 
     def _left_join(self, lt: DTable, rt: DTable, order, lo, counts,
@@ -2353,18 +2598,13 @@ class JaxExecutor:
                              fill_value=0)[0].astype(jnp.int32)
         um_rows = um_idx[jnp.clip(pos - n_matched, 0, out_cap - 1)]
         out_alive = pos < (n_matched + n_unmatched)
-        cols: Dict[str, DCol] = {}
-        for n in lt.column_names:
-            mc, uc = inner_c.column(n), lt.column(n)
-            data = jnp.where(is_m, mc.data[mi], uc.data[um_rows])
-            valid = jnp.where(is_m, mc.valid[mi], uc.valid[um_rows]) & \
-                out_alive
-            cols[n] = DCol(data, valid, mc.ctype, mc.dictionary, uc.bounds)
-        for n in rt.column_names:
-            mc = inner_c.column(n)
-            valid = jnp.where(is_m, mc.valid[mi], False) & out_alive
-            cols[n] = DCol(mc.data[mi], valid, mc.ctype, mc.dictionary,
-                           mc.bounds)
+        cols = _select_cols(
+            {n: inner_c.column(n) for n in lt.column_names},
+            {n: lt.column(n) for n in lt.column_names},
+            mi, um_rows, is_m, out_alive)
+        cols.update(_gather_cols(
+            {n: inner_c.column(n) for n in rt.column_names},
+            mi, is_m & out_alive))
         return DTable(cols, out_alive)
 
 
@@ -2377,13 +2617,19 @@ class _CompiledPlan:
     # per-table column subset actually scanned (None = all columns)
     table_cols: Dict[str, Optional[List[str]]] = None
     fn: object = None                    # jitted replay function
-    out_meta: List[tuple] = None         # (name, ctype, dictionary)
+    out_meta: List[tuple] = None         # (name, ctype, dictionary, bounds)
     # loaded from disk and not yet validated by a successful replay —
     # the first execution self-heals (rediscovers) on any failure
     preloaded: bool = False
     # fn has executed successfully at least once: later backend errors
     # are real device failures and propagate instead of falling back
     fn_validated: bool = False
+    # segmented compilation (parent programs only): fingerprints of the
+    # separately-compiled subtrees this plan consumes via DeviceResult
+    seg_fps: Optional[List[str]] = None
+    # output capacity after the final compact (segment replays feed the
+    # parent at exactly this padded size)
+    out_capacity: int = 0
 
 
 def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
@@ -2402,13 +2648,75 @@ def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
     return out
 
 
+def _seg_argname(fp: str) -> str:
+    """Replay-argument key for a segment result (cannot collide with a
+    table name: NUL is not legal in identifiers)."""
+    return "\x00seg:" + fp
+
+
+# segmented compilation thresholds: one whole-query XLA program wedges
+# the TPU compiler somewhere past ~5k HLO ops (q4 traces to 10k and
+# hangs the remote-compile RPC; q1/q3/q6 at 1-2k compile in seconds), so
+# plans above _SEG_MIN_TOTAL nodes compile their big aggregate subtrees
+# as separate programs whose results stay device-resident.
+_SEG_CUT_TYPES = (lp.Aggregate, lp.Window, lp.Distinct)
+_SEG_MIN_NODES = 5       # minimum subtree size worth its own program
+_SEG_MIN_TOTAL = 14      # plans smaller than this stay single-program
+
+
+def _cut_segments(p: lp.Plan):
+    """Split a plan for segmented compilation.
+
+    Returns ``(parent_plan, segments)`` where segments is an ordered
+    {fingerprint: subplan} of maximal Aggregate/Window/Distinct subtrees
+    and parent_plan has each occurrence replaced by lp.DeviceResult.
+    Identical subtrees (multi-part CTE instantiation) share one segment.
+    Deterministic for a given plan tree — discovery, replay, and record
+    reload all cut identically."""
+    segs: Dict[str, lp.Plan] = {}
+    if sum(1 for _ in p.walk()) < _SEG_MIN_TOTAL:
+        return p, segs
+
+    import copy as _copy
+
+    def rebuild(node: lp.Plan, is_root: bool) -> lp.Plan:
+        if not is_root and isinstance(node, _SEG_CUT_TYPES) and \
+                sum(1 for _ in node.walk()) >= _SEG_MIN_NODES:
+            fp = _plan_fp(node)
+            segs.setdefault(fp, node)
+            return lp.DeviceResult(fp)
+        kids = node.children()
+        if not kids:
+            return node
+        new_kids = [rebuild(k, False) for k in kids]
+        if all(nk is k for nk, k in zip(new_kids, kids)):
+            return node
+        q = _copy.copy(node)
+        if hasattr(q, "child"):
+            q.child = new_kids[0]
+        elif hasattr(q, "left"):
+            q.left, q.right = new_kids
+        else:
+            raise RuntimeError(
+                f"unknown child layout on {type(node).__name__}")
+        return q
+
+    parent = rebuild(p, True)
+    return parent, segs
+
+
 class CompilingExecutor(JaxExecutor):
     """JaxExecutor + whole-query compile cache keyed by SQL text.
 
     First execution of a query discovers its size plan eagerly; later
-    executions run ONE jitted XLA program per query (the performance
-    contract on real TPUs).  Guard failure (size-class overflow after
-    data changes) or catalog-version changes trigger rediscovery.
+    executions run a FIXED set of jitted XLA programs per query — one
+    parent program plus one per cut segment (_cut_segments); results of
+    segments stay on the device and feed the parent as arguments.
+    Segmentation bounds program size (the TPU compiler wedges on ~10k-op
+    whole-query programs), shares identical CTE subtrees across query
+    parts, and isolates numpy fallbacks to the segment that needs them.
+    Guard failure (size-class overflow after data changes) or catalog
+    version changes trigger rediscovery.
     """
 
     def execute_cached(self, p: lp.Plan, key: str) -> Table:
@@ -2418,33 +2726,34 @@ class CompilingExecutor(JaxExecutor):
         if cp is not None and cp.versions != versions:
             cp = None
         if cp is None:
-            return self._discover(p, key, versions)
+            return self._discover_query(p, key, versions)
         if not cp.compilable:
-            return self.execute_to_host(cp.plan)
+            result = self._eager_with_segments(cp)
+            if result is None:   # a shared segment was evicted: rebuild
+                return self._forget_and_rediscover(p, key, versions)
+            return result
         if cp.fn is None:
             # size-plan record preloaded from disk (see
             # save/load_compile_records): build the jitted replay now
             try:
                 cp.fn = self._build_jit(cp)
             except Exception:
-                self._compiled.pop(key, None)
-                return self._discover(p, key, versions)
+                return self._forget_and_rediscover(p, key, versions)
         if cp.preloaded:
             # first execution of a disk-loaded record: ANY failure —
             # arg build, compile, execution, or result assembly against
             # stale out_meta — means the record drifted; rediscover
             try:
-                result = self._replay(cp)
+                result = self._replay_query(cp)
             except Exception:
                 result = None
             if result is None:
-                self._compiled.pop(key, None)
-                return self._discover(p, key, versions)
+                return self._forget_and_rediscover(p, key, versions)
             cp.preloaded = False
             cp.fn_validated = True
             return result
         try:
-            result = self._replay(cp)
+            result = self._replay_query(cp)
         except jax.errors.JaxRuntimeError as first_err:
             if cp.fn_validated:
                 raise  # a real device failure, not a compile rejection
@@ -2452,31 +2761,70 @@ class CompilingExecutor(JaxExecutor):
             # (preemption/OOM): retry once before permanently demoting
             # this query to the eager per-op path — slower, correct
             try:
-                result = self._replay(cp)
+                result = self._replay_query(cp)
             except jax.errors.JaxRuntimeError:
                 print(f"WARNING: whole-query compile failed twice, "
                       f"running eagerly: {first_err}")
                 cp.compilable = False
                 cp.fn = None
-                return self.execute_to_host(cp.plan)
+                return self._eager_with_segments(cp)
         if result is None:  # size-class guard failed: data changed
-            self._compiled.pop(key, None)
-            return self._discover(p, key, versions)
+            return self._forget_and_rediscover(p, key, versions)
         cp.fn_validated = True
         return result
 
-    def _replay(self, cp: _CompiledPlan) -> Optional[Table]:
-        """Run the jitted whole-query program; None = size guard failed."""
+    def _forget_and_rediscover(self, p, key, versions) -> Table:
+        cp = self._compiled.pop(key, None)
+        if cp is not None:
+            for fp in (cp.seg_fps or ()):
+                self._seg_compiled.pop(fp, None)
+        return self._discover_query(p, key, versions)
+
+    # -- replay ---------------------------------------------------------------
+
+    def _replay_query(self, cp: _CompiledPlan) -> Optional[Table]:
+        """Dispatch segment programs then the parent; ONE batched
+        device->host fetch at the end (a fetch costs a tunnel round
+        trip).  None = some size guard failed (data changed)."""
+        seg_args = {}
+        seg_oks = []
+        for fp in (cp.seg_fps or ()):
+            scp = self._seg_compiled.get(fp)
+            if scp is None or scp.versions != cp.versions:
+                return None
+            if scp.compilable:
+                if scp.fn is None:
+                    scp.fn = self._build_jit(scp)
+                args = {t: self._accel_args(t, c)
+                        for t, c in scp.table_cols.items()}
+                (out, alive), ok = scp.fn(args)
+                seg_args[_seg_argname(fp)] = (out, alive)
+                seg_oks.append(ok)
+            else:
+                # fallback-isolated segment: host numpy result, shipped
+                # to the device at the recorded output capacity
+                host = self.execute_to_host(scp.plan)
+                seg_args[_seg_argname(fp)] = self._seg_host_args(
+                    scp, host)
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
+        args.update(seg_args)
         (out, alive), ok = cp.fn(args)
-        # ONE batched device->host fetch: per-array np.asarray costs a
-        # tunnel round-trip each (~10-30ms on the axon TPU link)
-        (out, alive_np), ok = jax.device_get(((out, alive), ok))
-        if not bool(ok):
+        (out, alive_np), okv, seg_okv = jax.device_get(
+            ((out, alive), ok, seg_oks))
+        if not (bool(okv) and all(bool(o) for o in seg_okv)):
             return None
+        for fp in (cp.seg_fps or ()):
+            scp = self._seg_compiled.get(fp)
+            if scp is not None:
+                scp.preloaded = False
+                scp.fn_validated = True
+        return self._assemble_host(cp, out, alive_np)
+
+    @staticmethod
+    def _assemble_host(cp: _CompiledPlan, out, alive_np) -> Table:
         cols = {}
-        for name, ctype, dictionary in cp.out_meta:
+        for name, ctype, dictionary, _bounds in cp.out_meta:
             data, valid = out[name]
             data = data[alive_np]
             valid = valid[alive_np]
@@ -2484,8 +2832,92 @@ class CompilingExecutor(JaxExecutor):
                                 None if valid.all() else valid, dictionary)
         return Table(cols)
 
-    def _discover(self, p: lp.Plan, key: str, versions) -> Table:
+    def _replay_one(self, scp: _CompiledPlan) -> Optional[Table]:
+        """Replay a single segment program to a host Table (reuse path:
+        a second query part sharing an already-compiled segment)."""
+        if not scp.compilable:
+            return self.execute_to_host(scp.plan)
+        if scp.fn is None:
+            scp.fn = self._build_jit(scp)
+        args = {t: self._accel_args(t, c)
+                for t, c in scp.table_cols.items()}
+        (out, alive), ok = scp.fn(args)
+        (out, alive_np), okv = jax.device_get(((out, alive), ok))
+        if not bool(okv):
+            return None
+        return self._assemble_host(scp, out, alive_np)
+
+    def _seg_host_args(self, scp: _CompiledPlan, host: Table):
+        """(cols, alive) replay-argument structure for a host-computed
+        segment result, padded to the segment's recorded capacity."""
+        cap = max(scp.out_capacity, size_class(max(host.num_rows, 1)))
+        n = host.num_rows
+        alive = np.zeros(cap, bool)
+        alive[:n] = True
+        cols = {}
+        for name, ctype, dictionary, _bounds in scp.out_meta:
+            col = host.columns[name]
+            data = _pad(np.asarray(col.data), cap)
+            valid = _pad(col.validity(), cap, fill=False)
+            cols[name] = (jnp.asarray(data), jnp.asarray(valid))
+        return (cols, jnp.asarray(alive))
+
+    def _dt_from_host(self, scp: _CompiledPlan, host: Table) -> DTable:
+        """Eager DTable view of a segment's host result carrying EXACTLY
+        the segment's out_meta (ctype/dictionary/bounds): parent
+        discovery must see the same static metadata replay will, or the
+        traced parent program diverges from the discovered record."""
+        (cols, alive) = self._seg_host_args(scp, host)
+        dcols = {}
+        for name, ctype, dictionary, bounds in scp.out_meta:
+            d, v = cols[name]
+            dcols[name] = DCol(d, v, ctype, dictionary, bounds)
+        return DTable(dcols, alive)
+
+    # -- discovery ------------------------------------------------------------
+
+    def _discover_query(self, p: lp.Plan, key: str, versions) -> Table:
+        parent, segs = _cut_segments(p)
+        self._seg_tables = {}
+        for fp, sub in segs.items():
+            dt = None
+            scp = self._seg_compiled.get(fp)
+            if scp is not None and scp.versions == versions:
+                # already compiled for another query (part): replay it
+                # for values instead of re-running eager discovery
+                try:
+                    host = self._replay_one(scp)
+                except Exception:
+                    host = None
+                if host is not None:
+                    with host_compute():
+                        dt = self._dt_from_host(scp, host)
+                    scp.preloaded = False
+                    scp.fn_validated = True
+            if dt is None:
+                scp, dt = self._discover_plan(sub, versions)
+                self._seg_compiled[fp] = scp
+            self._seg_tables[fp] = dt
+        # the parent's jit closure captures segment metas, so seg_fps
+        # MUST be set before the fn is built (build_fn=False + build
+        # here), or replay KeyErrors on the segment argument names
+        cp, dtp = self._discover_plan(parent, versions, build_fn=False)
+        cp.seg_fps = list(segs.keys())
+        if cp.compilable:
+            try:
+                cp.fn = self._build_jit(cp)
+            except Exception:
+                cp.compilable = False
+        self._compiled[key] = cp
+        with host_compute():
+            return to_host(dtp)
+
+    def _discover_plan(self, p: lp.Plan, versions, build_fn=True):
+        """Discover ONE program (parent or segment): eager host
+        execution recording every data-dependent decision; returns
+        (cp, compacted eager DTable)."""
         self._subq_cache = {}
+        self._tree_cache = {}
         self.np_exec = physical.Executor(self.catalog)
         self.mode = "discover"
         self._rec = []
@@ -2493,20 +2925,48 @@ class CompilingExecutor(JaxExecutor):
         try:
             with host_compute():
                 dt = self.execute(p)
-                host = to_host(dt)
+                # compact to the result's own size class BEFORE output:
+                # replay fetches (or hands the parent) every output
+                # column at padded capacity, and results are usually far
+                # smaller than the fact capacity they ride in on.  The
+                # compaction capacity is one more recorded sync point,
+                # so replay stays static.
+                dt = self.compact(dt)
         finally:
             self.mode = "eager"
         cp = _CompiledPlan(p, not self._used_fallback, self._rec, versions)
-        if cp.compilable:
-            cp.table_cols = _scan_columns(p)
-            cp.out_meta = [(name, c.ctype, c.dictionary)
-                           for name, c in dt.columns.items()]
+        cp.table_cols = _scan_columns(p)
+        cp.out_capacity = dt.capacity
+        cp.out_meta = [(name, c.ctype, c.dictionary, c.bounds)
+                       for name, c in dt.columns.items()]
+        if cp.compilable and build_fn:
             try:
                 cp.fn = self._build_jit(cp)
             except Exception:
                 cp.compilable = False
-        self._compiled[key] = cp
-        return host
+        return cp, dt
+
+    def _eager_with_segments(self, cp: _CompiledPlan):
+        """Non-compilable parent: numpy-interpreter execution over
+        segment results (still compiled where possible).  None when a
+        shared segment is missing or its guard failed — the caller
+        rediscovers."""
+        self._seg_tables = {}
+        for fp in (cp.seg_fps or ()):
+            scp = self._seg_compiled.get(fp)
+            if scp is None:
+                return None
+            try:
+                host = self._replay_one(scp)
+            except Exception:
+                host = None
+            if host is None:
+                return None
+            with host_compute():
+                self._seg_tables[fp] = self._dt_from_host(scp, host)
+        return self.execute_to_host(cp.plan)
+
+    # -- persisted size-plan records ------------------------------------------
 
     def _table_fingerprint(self, name: str) -> tuple:
         """Cheap content identity for a catalog table: row count + a
@@ -2522,6 +2982,8 @@ class CompilingExecutor(JaxExecutor):
                            .sum()) & (2 ** 61 - 1)
         return (name, t.num_rows, chk)
 
+    _REC_FORMAT = 3   # bump when the pickle schema changes
+
     def save_compile_records(self, path: str) -> int:
         """Persist discovery size-plan records (NOT compiled code — XLA
         has its own persistent cache) so a fresh process can skip the
@@ -2529,19 +2991,39 @@ class CompilingExecutor(JaxExecutor):
         text (the in-memory views-epoch prefix is process-local).
         Returns the record count."""
         import pickle
-        data = {}
+        data = {"\x00fmt": self._REC_FORMAT, "\x00segments": {}}
+        segstore = data["\x00segments"]
         for key, cp in self._compiled.items():
-            if cp.compilable and cp.record is not None:
-                sql = key.split("|", 1)[1] if "|" in key else key
-                try:
-                    fps = tuple(self._table_fingerprint(t)
-                                for t in sorted(cp.table_cols or ()))
-                except KeyError:
-                    continue  # references a since-dropped table
-                data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta)
+            if not (cp.compilable and cp.record is not None):
+                continue
+            sql = key.split("|", 1)[1] if "|" in key else key
+            try:
+                fps = tuple(self._table_fingerprint(t)
+                            for t in sorted(cp.table_cols or ()))
+            except KeyError:
+                continue  # references a since-dropped table
+            ok = True
+            for fp in (cp.seg_fps or ()):
+                scp = self._seg_compiled.get(fp)
+                if scp is None or scp.record is None:
+                    ok = False
+                    break
+                if fp not in segstore:
+                    try:
+                        sfps = tuple(self._table_fingerprint(t)
+                                     for t in sorted(scp.table_cols or ()))
+                    except KeyError:
+                        ok = False
+                        break
+                    segstore[fp] = (scp.record, sfps, scp.table_cols,
+                                    scp.out_meta, scp.out_capacity,
+                                    scp.compilable)
+            if ok:
+                data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta,
+                             cp.seg_fps, cp.out_capacity)
         with open(path, "wb") as f:
             pickle.dump(data, f)
-        return len(data)
+        return len(data) - 2
 
     def load_compile_records(self, path: str, plan_for_key,
                              key_prefix: str = "0") -> int:
@@ -2554,25 +3036,58 @@ class CompilingExecutor(JaxExecutor):
         import pickle
         with open(path, "rb") as f:
             data = pickle.load(f)
+        if not isinstance(data, dict) or \
+                data.get("\x00fmt") != self._REC_FORMAT:
+            return 0
+        segstore = data.get("\x00segments", {})
         versions_now = tuple(sorted(
             getattr(self.catalog, "versions", {}).items()))
-        n = 0
-        for sql, (record, fps, table_cols, out_meta) in data.items():
+
+        def fingerprints_ok(fps):
             try:
-                ok = all(self._table_fingerprint(fp[0]) == fp
-                         for fp in fps)
+                return all(self._table_fingerprint(fp[0]) == fp
+                           for fp in fps)
             except KeyError:
+                return False
+
+        n = 0
+        for sql, ent in data.items():
+            if sql.startswith("\x00"):
                 continue
-            if not ok:
+            (record, fps, table_cols, out_meta, seg_fps, out_cap) = ent
+            if not fingerprints_ok(fps):
                 continue
             plan = plan_for_key(sql)
             if plan is None:
                 continue
-            self._compiled[f"{key_prefix}|{sql}"] = _CompiledPlan(
-                plan, True, record, versions_now, table_cols, None,
-                out_meta, preloaded=True)
+            parent, segs = _cut_segments(plan)
+            if sorted(segs.keys()) != sorted(seg_fps or ()):
+                continue  # cut heuristic or plan changed: rediscover
+            seg_ok = True
+            for fp in (seg_fps or ()):
+                if fp in self._seg_compiled and \
+                        self._seg_compiled[fp].versions == versions_now:
+                    continue
+                sent = segstore.get(fp)
+                if sent is None or not fingerprints_ok(sent[1]):
+                    seg_ok = False
+                    break
+                (srec, _sfps, stc, som, socap, scomp) = sent
+                scp = _CompiledPlan(segs[fp], scomp, srec, versions_now,
+                                    stc, None, som, preloaded=True)
+                scp.out_capacity = socap
+                self._seg_compiled[fp] = scp
+            if not seg_ok:
+                continue
+            cp = _CompiledPlan(parent, True, record, versions_now,
+                               table_cols, None, out_meta, preloaded=True)
+            cp.seg_fps = list(seg_fps or ())
+            cp.out_capacity = out_cap
+            self._compiled[f"{key_prefix}|{sql}"] = cp
             n += 1
         return n
+
+    # -- replay argument assembly --------------------------------------------
 
     def _table_args(self, name: str, cols: Optional[List[str]] = None):
         dt = self._table_device(name)
@@ -2624,20 +3139,29 @@ class CompilingExecutor(JaxExecutor):
             dt = self._table_device(name)
             metas[name] = {n: (c.ctype, c.dictionary, c.bounds)
                            for n, c in dt.columns.items()}
+        for fp in (cp.seg_fps or ()):
+            scp = self._seg_compiled[fp]
+            metas[_seg_argname(fp)] = {
+                n: (ct, d, b) for n, ct, d, b in scp.out_meta}
 
         def replay(tables):
             self._subq_cache = {}
+            self._tree_cache = {}
             self.mode = "replay"
             self._pos = 0
             self._oks = []
             self._rec = cp.record
             self._trace_tables = {}
             for name, (cols, alive) in tables.items():
-                dcols = {n: DCol(d, v, *metas[name][n])
-                         for n, (d, v) in cols.items()}
+                # iterate in META order, not arg order: jax pytrees sort
+                # dict keys, and column ORDER must match what discovery
+                # saw (SubqueryAlias zips aliases positionally)
+                dcols = {n: DCol(*cols[n], *metas[name][n])
+                         for n in metas[name] if n in cols}
                 self._trace_tables[name] = DTable(dcols, alive)
             try:
                 dt = self.execute(cp.plan)
+                dt = self.compact(dt)   # mirror of _discover_plan
                 ok = jnp.asarray(True)
                 for o in self._oks:
                     ok = ok & o

@@ -138,6 +138,16 @@ class SetOp(Plan):
 
 
 @dataclasses.dataclass
+class DeviceResult(Plan):
+    """Leaf standing in for a separately-compiled plan segment whose
+    result is already resident on the device (jaxexec segmented
+    compilation: one whole-query program per SQL text wedges the TPU
+    compiler past ~5k ops, so big aggregate subtrees compile as their
+    own programs and feed the parent as arguments)."""
+    key: str  # segment fingerprint (jaxexec._plan_fp of the subtree)
+
+
+@dataclasses.dataclass
 class SubqueryAlias(Plan):
     """Named derived table / CTE reference."""
     child: Plan

@@ -192,7 +192,12 @@ def _cell_bytes(typ, sl: pa.ChunkedArray, mask: np.ndarray,
         return raw[~mask].reshape(-1), lens
     if pa.types.is_decimal(typ):
         # unscaled int from the decimal128 storage (16B little-endian
-        # two's complement); NDS decimals fit the low signed word
+        # two's complement); NDS decimals fit the low signed word —
+        # reject anything wider instead of silently truncating
+        if typ.precision > 18:
+            raise NotImplementedError(
+                f"avro encode: decimal precision {typ.precision} > 18 "
+                f"needs >64-bit unscaled values")
         arr = sl
         raw = np.frombuffer(arr.buffers()[1], np.int64,
                             2 * count, arr.offset * 16).reshape(count, 2)

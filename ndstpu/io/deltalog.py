@@ -71,6 +71,10 @@ class _State:
     # path -> {"path", "rows"}
     files: Dict[str, Dict] = field(default_factory=dict)
     partition_col: Optional[str] = None
+    # relative path of a data file carrying the CURRENT schema (written
+    # at create/replace time): empty reads must not guess from an
+    # arbitrary historical part file, whose pre-replace schema may differ
+    schema_file: Optional[str] = None
 
 
 def _versions(table_dir: str) -> List[int]:
@@ -109,6 +113,7 @@ def _commit(table_dir: str, version: int, actions: List[Dict],
         _publish(cp, [json.dumps({
             "version": st.version, "timestamp": st.timestamp,
             "partition_col": st.partition_col,
+            "schema_file": st.schema_file,
             "files": list(st.files.values())})])
         _publish(os.path.join(_log_dir(table_dir), "_last_checkpoint"),
                  [json.dumps({"version": version})])
@@ -138,6 +143,7 @@ def _replay(table_dir: str, version: Optional[int] = None) -> _State:
             d = json.loads(f.read().strip())
         st.files = {fm["path"]: fm for fm in d["files"]}
         st.partition_col = d.get("partition_col")
+        st.schema_file = d.get("schema_file")
         st.timestamp = d["timestamp"]
         start = cp + 1
     for v in range(start, version + 1):
@@ -154,6 +160,8 @@ def _replay(table_dir: str, version: Optional[int] = None) -> _State:
                     st.timestamp = a["commitInfo"]["timestamp"]
                 elif "metaData" in a:
                     st.partition_col = a["metaData"].get("partition_col")
+                    st.schema_file = a["metaData"].get(
+                        "schema_file", st.schema_file)
                 elif "add" in a:
                     st.files[a["add"]["path"]] = a["add"]
                 elif "remove" in a:
@@ -186,9 +194,11 @@ def create_table(table_dir: str, at: pa.Table,
         removes = [{"remove": {"path": p}} for p in prev.files]
     else:
         version, removes = 0, []
+    fm = _new_data_file(table_dir, at)
     actions = removes + [
-        {"metaData": {"partition_col": partition_col}},
-        {"add": _new_data_file(table_dir, at)}]
+        {"metaData": {"partition_col": partition_col,
+                      "schema_file": fm["path"]}},
+        {"add": fm}]
     _commit(table_dir, version, actions, "CREATE OR REPLACE")
 
 
@@ -233,15 +243,21 @@ def read(table_dir: str, version: Optional[int] = None,
                            columns=columns)
              for fm in st.files.values()]
     if not parts:
-        # fully-deleted table: 0 rows, schema from any historical data
-        # file (copy-on-write never unlinks them) — ndslake parity;
-        # schema-only read, no row data touched
-        for name in sorted(os.listdir(table_dir)):
-            if name.startswith("part-") and name.endswith(".parquet"):
-                sch = pq.read_schema(os.path.join(table_dir, name))
-                if columns is not None:
-                    sch = pa.schema([sch.field(c) for c in columns])
-                return sch.empty_table()
+        # fully-deleted table: 0 rows; schema from the metaData-recorded
+        # file of the CURRENT table generation (an arbitrary historical
+        # part file could carry a pre-replace schema), falling back to
+        # any part file for logs created before schema_file existed
+        names = [st.schema_file] if st.schema_file else \
+            sorted(n for n in os.listdir(table_dir)
+                   if n.startswith("part-") and n.endswith(".parquet"))
+        for name in names:
+            fp = os.path.join(table_dir, name)
+            if not os.path.exists(fp):
+                continue
+            sch = pq.read_schema(fp)
+            if columns is not None:
+                sch = pa.schema([sch.field(c) for c in columns])
+            return sch.empty_table()
         raise FileNotFoundError(f"no data files in {table_dir}")
     return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
 

@@ -47,7 +47,12 @@ for q in {QUERIES!r}:
         out[name] = round(time.time() - t0, 4)
 print("RESULT " + json.dumps(out))
 """
-    env = dict(os.environ, NDSTPU_GROUPBY=mode, PYTHONPATH=str(REPO))
+    # APPEND to PYTHONPATH: clobbering it drops /root/.axon_site's
+    # sitecustomize, so the child can't register the axon PJRT plugin
+    # that its inherited JAX_PLATFORMS=axon demands
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ, NDSTPU_GROUPBY=mode,
+               PYTHONPATH=f"{REPO}{os.pathsep}{pp}" if pp else str(REPO))
     t0 = time.time()
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, timeout=3600)

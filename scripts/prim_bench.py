@@ -17,16 +17,6 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def run_chain(build, k, reps=4):
-    f = jax.jit(lambda *xs: build(k, *xs))
-
-    def once(args):
-        o = f(*args)
-        leaf = jax.tree_util.tree_leaves(o)[0]
-        _ = np.asarray(leaf.ravel()[0])
-    return f
-
-
 def timeit(build, args, k, reps=4):
     f = jax.jit(lambda *xs: build(k, *xs))
     o = f(*args)  # compile
