@@ -176,6 +176,7 @@ class Session:
         regression cannot hide as an invisible perf cliff."""
         import os
         import sys
+        import warnings
         if os.environ.get("NDSTPU_SPMD_STRICT"):
             raise e
         errs = getattr(self, "_spmd_errors", None)
@@ -186,6 +187,10 @@ class Session:
                   f"({type(e).__name__}: {e}); falling back to the "
                   f"single-chip path (further fallbacks collected in "
                   f"Session._spmd_errors)", file=sys.stderr)
+        # surfaces in the BenchReport as CompletedWithTaskFailures —
+        # the reference's task-failure listener analog (report.py)
+        warnings.warn(f"distributed executor fell back to single-chip: "
+                      f"{type(e).__name__}: {e}", stacklevel=2)
         errs.append(repr(e))
 
     def _mesh(self):
