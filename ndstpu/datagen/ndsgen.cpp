@@ -56,6 +56,30 @@ struct Rng {
   bool chance(double p) { return (next() >> 11) * 0x1.0p-53 < p; }
   // money in cents, uniform [lo_cents, hi_cents]
   int64_t cents(int64_t lo, int64_t hi) { return range(lo, hi); }
+  // Zipf(s~1)-skewed pick in [1, n]: rank = floor(n^u) gives
+  // P(rank <= k) = ln(k+1)/ln(n+1) — a handful of hot keys carry most
+  // of the mass, like dsdgen's weighted distribution tables give real
+  // NDS data (reference nds/tpcds-gen; uniform draws made every
+  // selectivity and every join fan-out unrealistically flat).  The
+  // rank is scattered over the key space by a coprime multiplier so
+  // hot keys are spread out, not clustered at 1..k.  One next() call —
+  // counter-stream stability for the re-derivation in gen_return.
+  int64_t zipf(int64_t n) {
+    if (n <= 1) return 1;
+    double u = (next() >> 11) * 0x1.0p-53;  // [0, 1)
+    double rf = exp(u * log((double)n + 1.0));
+    int64_t rank = (int64_t)rf;  // 1..n
+    if (rank < 1) rank = 1;
+    if (rank > n) rank = n;
+    static const uint64_t kScatter[] = {2654435761ULL, 1073741827ULL,
+                                        805306457ULL, 100000007ULL};
+    for (uint64_t p : kScatter) {
+      uint64_t a = p % (uint64_t)n, b = (uint64_t)n;  // gcd(p, n) == 1?
+      while (a) { uint64_t t = b % a; b = a; a = t; }
+      if (b == 1) return (int64_t)(((uint64_t)(rank - 1) * p) % (uint64_t)n) + 1;
+    }
+    return rank;  // no coprime scatter (tiny n): unscattered rank
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -234,6 +258,26 @@ static const char* pick(Rng& r, const char* const (&pool)[N]) {
   return pool[r.next() % N];
 }
 
+// Weighted county pick — the analog of dsdgen's fips_county
+// distribution table (reference nds/tpcds-gen/patches/templates.patch
+// `distmember(fips_county, ...)`): a few counties dominate, so county
+// predicates (query16/34/...) see realistic selectivity instead of a
+// uniform 1/20.  Weights mirror ndstpu/queries/streamgen.py
+// _DISTRIBUTIONS["fips_county"] — keep the two in sync.
+static const int kCountyWeights[] = {100, 80, 60, 45, 35, 28, 22, 18, 14,
+                                     11, 9, 7, 6, 5, 4, 3, 3, 2, 2, 1};
+static const char* pick_county(Rng& r) {
+  static int total = 0;
+  if (!total)
+    for (int w : kCountyWeights) total += w;
+  int64_t x = r.range(0, total - 1);
+  for (size_t i = 0; i < sizeof(kCountyWeights) / sizeof(int); i++) {
+    x -= kCountyWeights[i];
+    if (x < 0) return kCounties[i];
+  }
+  return kCounties[0];
+}
+
 static std::string sentence(Rng& r, int nwords) {
   std::string s;
   for (int i = 0; i < nwords; i++) {
@@ -365,10 +409,22 @@ static SaleCore gen_sale(uint64_t table_id, int64_t row, int64_t n_channel,
   SaleCore s;
   s.null_date = r.chance(0.02);
   s.date_sk = r.range(SALES_FIRST_JD, SALES_LAST_JD);
+  // holiday-season date skew: ~30% of sales land in Nov/Dec (dsdgen
+  // concentrates sales around the holidays the same way; uniform dates
+  // starved the date-partition pruning and Q-over-December queries of
+  // realistic selectivity).  Both draws always happen — the counter
+  // stream must not depend on the branch (returns re-derive the sale).
+  bool holiday = r.chance(0.30);
+  int64_t hol_off = r.range(0, 60);
+  if (holiday) {
+    Civil c = civil_from_days(s.date_sk - JD_EPOCH_1970);
+    int y = c.y > 2002 ? 2002 : c.y;  // Nov 2003 exceeds the window
+    s.date_sk = days_from_civil(y, 11, 1) + JD_EPOCH_1970 + hol_off;
+  }
   s.time_sk = r.range(0, 86399);
-  s.item_sk = r.range(1, g_sz.item);
+  s.item_sk = r.zipf(g_sz.item);
   s.null_customer = r.chance(0.03);
-  s.customer_sk = r.range(1, g_sz.customer);
+  s.customer_sk = r.zipf(g_sz.customer);
   s.cdemo_sk = r.range(1, g_sz.customer_demographics);
   s.hdemo_sk = r.range(1, g_sz.household_demographics);
   s.addr_sk = r.range(1, g_sz.customer_address);
@@ -455,7 +511,7 @@ static void gen_customer_address(Writer& w, int64_t b, int64_t e) {
     } else
       w.fnull();
     w.fstr(pick(r, kCities));
-    w.fstr(pick(r, kCounties));
+    w.fstr(pick_county(r));
     const char* st = pick(r, kStates);
     w.fstr(st);
     char zip[8];
@@ -591,7 +647,7 @@ static void gen_warehouse(Writer& w, int64_t b, int64_t e) {
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
     w.fstr(pick(r, kCities));
-    w.fstr(pick(r, kCounties));
+    w.fstr(pick_county(r));
     w.fstr(pick(r, kStates));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
@@ -734,7 +790,7 @@ static void gen_store(Writer& w, int64_t b, int64_t e) {
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
     w.fstr(pick(r, kCities));
-    w.fstr(pick(r, kCounties));
+    w.fstr(pick_county(r));
     w.fstr(kStates[i % 12]);  // concentrate stores in few states like TPC
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
@@ -783,7 +839,7 @@ static void gen_call_center(Writer& w, int64_t b, int64_t e) {
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
     w.fstr(pick(r, kCities));
-    w.fstr(pick(r, kCounties));
+    w.fstr(pick_county(r));
     w.fstr(pick(r, kStates));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
@@ -862,7 +918,7 @@ static void gen_web_site(Writer& w, int64_t b, int64_t e) {
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
     w.fstr(pick(r, kCities));
-    w.fstr(pick(r, kCounties));
+    w.fstr(pick_county(r));
     w.fstr(pick(r, kStates));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));

@@ -247,9 +247,21 @@ def read(table_dir: str, version: Optional[int] = None,
         # file of the CURRENT table generation (an arbitrary historical
         # part file could carry a pre-replace schema), falling back to
         # any part file for logs created before schema_file existed
-        names = [st.schema_file] if st.schema_file else \
-            sorted(n for n in os.listdir(table_dir)
-                   if n.startswith("part-") and n.endswith(".parquet"))
+        # the recorded file first; if it was cleaned up externally,
+        # fall through to scanning historical part files rather than
+        # failing the read of an empty table — but WARN, because a
+        # historical part can carry a pre-replace schema
+        names = [st.schema_file] if st.schema_file else []
+        if names and not os.path.exists(
+                os.path.join(table_dir, names[0])):
+            import warnings
+            warnings.warn(
+                f"deltalog: recorded schema file {names[0]} missing in "
+                f"{table_dir}; falling back to historical part files "
+                f"(schema may predate the last table replace)",
+                stacklevel=2)
+        names += sorted(n for n in os.listdir(table_dir)
+                        if n.startswith("part-") and n.endswith(".parquet"))
         for name in names:
             fp = os.path.join(table_dir, name)
             if not os.path.exists(fp):

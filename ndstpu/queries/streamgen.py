@@ -17,6 +17,15 @@ dialect):
 Templates declare parameters in a header line per parameter:
     --@ define NAME = uniform(lo, hi)      integer uniform inclusive
     --@ define NAME = choice(v1, v2, ...)  pick one literal
+    --@ define NAME = dist(dname)          weighted pick from a named
+                                           distribution (dsqgen
+                                           `distmember` analog, cf.
+                                           reference nds/tpcds-gen/
+                                           patches/templates.patch
+                                           `distmember(fips_county,...)`)
+    --@ define NAME = distlist(dname, k)   k DISTINCT weighted picks,
+                                           substituted as [NAME.1] ..
+                                           [NAME.k]
 ``[NAME]`` occurrences in the body are substituted.  Arithmetic like
 ``[NAME] + 10`` stays in SQL.
 """
@@ -34,7 +43,45 @@ from typing import Dict, List, Optional, Tuple
 TEMPLATE_DIR = Path(__file__).resolve().parent / "templates"
 
 _DEFINE_RE = re.compile(
-    r"^--@\s*define\s+(\w+)\s*=\s*(uniform|choice)\((.*)\)\s*$")
+    r"^--@\s*define\s+(\w+)\s*=\s*(uniform|choice|dist|distlist)"
+    r"\((.*)\)\s*$")
+
+# Named weighted value distributions — the dsqgen distribution-table
+# analog (the TPC toolkit ships these as .dst files; dsqgen's
+# `distmember(fips_county, [N], 2)` picks weighted county names).
+# fips_county weights MUST mirror ndsgen.cpp kCountyWeights — the
+# generator draws county columns from the same distribution so that
+# substituted predicates see realistic (non-uniform) selectivity.
+_DISTRIBUTIONS: Dict[str, List[Tuple[str, int]]] = {
+    "fips_county": [
+        ("Williamson County", 100), ("Walker County", 80),
+        ("Ziebach County", 60), ("Daviess County", 45),
+        ("Barrow County", 35), ("Franklin Parish", 28),
+        ("Luce County", 22), ("Richland County", 18),
+        ("Furnas County", 14), ("Maverick County", 11),
+        ("Pennington County", 9), ("Bronx County", 7),
+        ("Jackson County", 6), ("Mesa County", 5),
+        ("Dauphin County", 4), ("Levy County", 3),
+        ("Coal County", 3), ("Mobile County", 2),
+        ("San Miguel County", 2), ("Perry County", 1),
+    ],
+}
+
+
+def _dist_pick(rng: random.Random, dname: str, k: int = 1) -> List[str]:
+    """k distinct weighted picks from a named distribution."""
+    pool = list(_DISTRIBUTIONS[dname])
+    out = []
+    for _ in range(min(k, len(pool))):
+        total = sum(w for _, w in pool)
+        x = rng.randrange(total)
+        for i, (v, w) in enumerate(pool):
+            x -= w
+            if x < 0:
+                out.append(v)
+                del pool[i]
+                break
+    return out
 
 
 def list_templates(template_dir: Optional[str] = None) -> List[str]:
@@ -70,12 +117,19 @@ def render_template(template_path: str, rngseed: str, stream: int) -> str:
     for name, (kind, vals) in params.items():
         if kind == "uniform":
             v = str(rng.randint(int(vals[0]), int(vals[1])))
+        elif kind == "dist":
+            v = _dist_pick(rng, vals[0])[0]
+        elif kind == "distlist":
+            picks = _dist_pick(rng, vals[0], int(vals[1]))
+            for i, p in enumerate(picks, 1):
+                body = body.replace(f"[{name}.{i}]", p)
+            continue
         else:  # choice
             v = rng.choice(vals).strip()
             if v.startswith("'") and v.endswith("'"):
                 v = v[1:-1]
         body = body.replace(f"[{name}]", v)
-    leftover = re.findall(r"\[([A-Z][A-Z0-9_]*)\]", body)
+    leftover = re.findall(r"\[([A-Z][A-Z0-9_.]*)\]", body)
     if leftover:
         raise ValueError(
             f"{template_path}: unsubstituted parameters {sorted(set(leftover))}")

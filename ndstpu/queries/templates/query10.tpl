@@ -1,5 +1,6 @@
 --@ define YEAR = uniform(1999, 2002)
 --@ define MONTH = uniform(1, 4)
+--@ define COUNTY = distlist(fips_county, 5)
 select
   cd_gender,
   cd_marital_status,
@@ -19,7 +20,7 @@ select
   customer c,customer_address ca,customer_demographics
  where
   c.c_current_addr_sk = ca.ca_address_sk and
-  ca_county in ('Rush County','Toole County','Jefferson County','Dona Ana County','La Porte County') and
+  ca_county in ('[COUNTY.1]','[COUNTY.2]','[COUNTY.3]','[COUNTY.4]','[COUNTY.5]') and
   cd_demo_sk = c.c_current_cdemo_sk and
   exists (select *
           from store_sales,date_dim

@@ -1,6 +1,7 @@
 --@ define MONTH = uniform(2, 5)
 --@ define YEAR = uniform(1999, 2002)
 --@ define STATE = choice('GA','TX','CA','NY','IL','OH','PA','NC')
+--@ define COUNTY = distlist(fips_county, 5)
 select
    count(distinct cs_order_number) as order_count
   ,sum(cs_ext_ship_cost) as total_shipping_cost
@@ -17,8 +18,8 @@ and cs1.cs_ship_date_sk = d_date_sk
 and cs1.cs_ship_addr_sk = ca_address_sk
 and ca_state = '[STATE]'
 and cs1.cs_call_center_sk = cc_call_center_sk
-and cc_county in ('Williamson County','Williamson County','Williamson County','Williamson County',
-                  'Williamson County')
+and cc_county in ('[COUNTY.1]','[COUNTY.2]','[COUNTY.3]','[COUNTY.4]',
+                  '[COUNTY.5]')
 and exists (select *
             from catalog_sales cs2
             where cs1.cs_order_number = cs2.cs_order_number

@@ -1,5 +1,6 @@
 --@ define YEAR = uniform(1998, 2000)
 --@ define BP = choice('>10000', '1001-5000')
+--@ define COUNTY = distlist(fips_county, 8)
 select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
        ss_ticket_number, cnt
 from (select ss_ticket_number, ss_customer_sk, count(*) cnt
@@ -17,10 +18,10 @@ from (select ss_ticket_number, ss_customer_sk, count(*) cnt
                        household_demographics.hd_vehicle_count
                   else null end) > 1.2
         and date_dim.d_year in ([YEAR], [YEAR] + 1, [YEAR] + 2)
-        and store.s_county in ('Williamson County', 'Franklin Parish',
-                               'Bronx County', 'Orange County',
-                               'Gaines County', 'Richland County',
-                               'Ziebach County', 'Luce County')
+        and store.s_county in ('[COUNTY.1]', '[COUNTY.2]',
+                               '[COUNTY.3]', '[COUNTY.4]',
+                               '[COUNTY.5]', '[COUNTY.6]',
+                               '[COUNTY.7]', '[COUNTY.8]')
       group by ss_ticket_number, ss_customer_sk) dn, customer
 where ss_customer_sk = c_customer_sk
   and cnt between 15 and 20

@@ -9,6 +9,9 @@ initialized (pytest loads conftest before test modules).
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# replay warm-up (compile at discovery) would add minutes of XLA:CPU
+# compiles across the suite; tests that exercise it opt in explicitly
+os.environ.setdefault("NDSTPU_WARM_REPLAY", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

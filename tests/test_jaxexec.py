@@ -378,6 +378,40 @@ def test_compiled_replay_path(catalog, cpu_sess):
     assert_tables_match(cpu_sess.sql(sql), second, ordered=True)
 
 
+def test_steady_state_no_retrace(catalog, cpu_sess, monkeypatch):
+    """With replay warm-up on (the bench configuration), the FIRST
+    execute_cached pays discovery + jit compile; every later execution
+    must dispatch only already-compiled programs — no discovery, no new
+    jit builds, no retrace.  Guards the r03 regression where query1's
+    'steady-state' second run took 59.4 s recompiling its replay."""
+    monkeypatch.setenv("NDSTPU_WARM_REPLAY", "1")
+    from ndstpu.engine.session import Session
+    sess = Session(catalog, backend="tpu")
+    sql = ("select i_category, count(*) as n, sum(ss_net_paid) as s "
+           "from store_sales join item on ss_item_sk = i_item_sk "
+           "where ss_quantity > 2 group by i_category "
+           "order by i_category")
+    first = sess.sql(sql)
+    exe = sess._jax_executor()
+    assert exe.warm_replay
+    cp = sess.compiled_plan(sql)
+    assert cp is not None and cp.compilable and cp.fn is not None
+    # warm-up already validated the jitted program during discovery
+    assert cp.fn_validated
+    disc, builds = exe.n_discoveries, exe.n_jit_builds
+    caches = [cp.fn] + [exe._seg_compiled[fp].fn
+                        for fp in (cp.seg_fps or ())]
+    sizes = [f._cache_size() for f in caches if f is not None]
+    for _ in range(2):
+        got = sess.sql(sql)
+        assert_tables_match(first, got, ordered=True)
+    assert exe.n_discoveries == disc, "steady-state run re-discovered"
+    assert exe.n_jit_builds == builds, "steady-state run re-built a jit"
+    assert [f._cache_size() for f in caches
+            if f is not None] == sizes, "steady-state run re-traced"
+    assert_tables_match(cpu_sess.sql(sql), got, ordered=True)
+
+
 def test_compiled_invalidation_on_dml(catalog):
     """Catalog version changes must invalidate compiled plans (stale
     baked subquery literals / table uploads)."""
