@@ -162,21 +162,31 @@ def _setup_xla_cache() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
-def _gen_fingerprint() -> str:
-    """Identity of the generation pipeline: a cached warehouse is only
-    valid for the generator/transcoder sources that built it — an
-    SF-only tag silently kept pre-change data alive across generator
-    changes (e.g. the r04 distribution skew)."""
+def _src_fingerprint(rels) -> str:
     import hashlib
     h = hashlib.sha256()
-    for rel in ("ndstpu/datagen/ndsgen.cpp", "ndstpu/datagen/driver.py",
-                "ndstpu/io/transcode.py", "ndstpu/schema.py"):
+    for rel in rels:
         try:
             with open(os.path.join(REPO, rel), "rb") as f:
                 h.update(f.read())
         except OSError:
             h.update(rel.encode())
     return h.hexdigest()[:16]
+
+
+# identity of the build pipeline per artifact: raw data depends only on
+# the generator; the warehouse additionally on the transcoder/schema.
+# An SF-only tag silently kept pre-change data alive across generator
+# changes (e.g. the r04 distribution skew); a single shared stamp would
+# waste a full datagen phase on transcoder-only edits.
+_GEN_SRCS = ("ndstpu/datagen/ndsgen.cpp", "ndstpu/datagen/driver.py")
+_WH_SRCS = _GEN_SRCS + ("ndstpu/io/transcode.py", "ndstpu/schema.py")
+# the CPU baseline is a function of (data, queries, interpreter): cached
+# times must not survive interpreter changes, or vs_baseline silently
+# compares against a stale denominator
+_CPU_SRCS = ("ndstpu/engine/physical.py", "ndstpu/engine/expr.py",
+             "ndstpu/engine/columnar.py", "ndstpu/engine/optimizer.py",
+             "ndstpu/engine/planner.py", "ndstpu/engine/plan.py")
 
 
 def _stamp_ok(d: str, fp: str) -> bool:
@@ -196,9 +206,10 @@ def _ensure_warehouse() -> str:
     tag = f"sf{SF:g}"
     raw = os.path.join(CACHE, f"raw_{tag}")
     wh = os.path.join(CACHE, f"wh_{tag}")
-    genfp = _gen_fingerprint()
-    for d in (raw, wh):
-        if os.path.isdir(d) and os.listdir(d) and not _stamp_ok(d, genfp):
+    raw_fp = _src_fingerprint(_GEN_SRCS)
+    wh_fp = _src_fingerprint(_WH_SRCS)
+    for d, fp in ((raw, raw_fp), (wh, wh_fp)):
+        if os.path.isdir(d) and os.listdir(d) and not _stamp_ok(d, fp):
             shutil.rmtree(d, ignore_errors=True)
     # append, don't clobber: the host env may carry a sitecustomize dir
     # (e.g. the axon PJRT plugin registration) on PYTHONPATH
@@ -223,7 +234,7 @@ def _ensure_warehouse() -> str:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise
             with open(os.path.join(tmp, ".genfp"), "w") as f:
-                f.write(genfp)
+                f.write(raw_fp)
             os.rename(tmp, raw)
         STATE["phase"] = "transcode"
         tmp = wh + "_tmp_"
@@ -239,16 +250,18 @@ def _ensure_warehouse() -> str:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         with open(os.path.join(tmp, ".genfp"), "w") as f:
-            f.write(genfp)
+            f.write(wh_fp)
         os.rename(tmp, wh)
     return wh
 
 
 def _corpus_fingerprint(wh: str, queries) -> str:
-    """Identity of (warehouse data, rendered query corpus): the CPU
-    baseline is a pure function of these, so cache it by this key."""
+    """Identity of (warehouse data, rendered query corpus, interpreter
+    sources): the CPU baseline is a pure function of these, so cache it
+    by this key."""
     import hashlib
     h = hashlib.sha256()
+    h.update(_src_fingerprint(_CPU_SRCS).encode())
     for name, sql in queries:
         h.update(name.encode())
         h.update(hashlib.sha256(sql.encode()).digest())

@@ -388,8 +388,12 @@ def _table_content_fp(t) -> str:
         if c.valid is not None:
             crc = zlib.crc32(np.ascontiguousarray(c.valid).tobytes(), crc)
         if c.dictionary is not None:
+            # length-prefix each entry: ['ab','c'] must not collide
+            # with ['a','bc'] under bare concatenation
+            crc = zlib.crc32(str(len(c.dictionary)).encode(), crc)
             for s in c.dictionary:
-                crc = zlib.crc32(str(s).encode(), crc)
+                b = str(s).encode()
+                crc = zlib.crc32(f"{len(b)}:".encode() + b, crc)
         parts.append(f"{name}:{c.ctype!r}:{data.dtype}{data.shape}:{crc}")
     fp = f"T({t.num_rows};" + ";".join(parts) + ")"
     try:

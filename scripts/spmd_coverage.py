@@ -1,12 +1,18 @@
-"""Distributed-coverage probe: which corpus query parts run under the
-tpu-spmd executor, and why the rest fall back.
+"""Distributed full-corpus differential: every corpus query part must
+execute under the tpu-spmd executor on an 8-device virtual mesh AND
+produce rows equal to the single-process numpy interpreter.
+
+This is the distributed analog of the reference's differential
+validation loop (/root/reference/nds/nds_validate.py:217-260): outputs
+are compared for EVERY query, not merely executed.
 
 Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-            python scripts/spmd_coverage.py [warehouse_dir]
+            python scripts/spmd_coverage.py [warehouse_dir] [--no-assert]
 
-Renders every template part, plans it, and attempts the distributed
-executor with a tiny shard threshold; prints a per-part verdict and a
-histogram of DistUnsupported reasons.  Guides which dplan gaps matter.
+Prints a per-part verdict (OK/ROWDIFF/FALL/ERR) and exits nonzero when
+any part falls back or mismatches (unless --no-assert).  The same
+comparison is enforced in CI by tests/test_parallel.py::
+test_dist_full_corpus_row_equal.
 """
 
 import collections
@@ -31,14 +37,92 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
-def main():
+def rows_match(want, got, eps=1e-5):
+    """Validator-semantics comparison: row sets equal within epsilon
+    (nds_validate.py:194-215 analog), order-insensitive."""
+    if len(want) != len(got):
+        return False
+
+    def key(r):
+        return tuple((v is None, str(v)) for v in r)
+
+    for rw, rg in zip(sorted(want, key=key), sorted(got, key=key)):
+        if len(rw) != len(rg):
+            return False
+        for vw, vg in zip(rw, rg):
+            if vw is None or vg is None:
+                if not (vw is None and vg is None):
+                    return False
+            elif isinstance(vw, float) or isinstance(vg, float):
+                fw, fg = float(vw), float(vg)
+                if fw != fg and abs(fw - fg) > \
+                        eps * max(1.0, abs(fw), abs(fg)):
+                    return False
+            elif vw != vg:
+                return False
+    return True
+
+
+def run_corpus(catalog, mesh, shard_threshold_rows=500, verbose=True):
+    """(ok, mismatched, fell) lists over every corpus part."""
+    from ndstpu.engine import physical
     from ndstpu.engine.session import Session
-    from ndstpu.io import loader
-    from ndstpu.parallel import dplan, mesh as pmesh
+    from ndstpu.parallel import dplan
     from ndstpu.queries import streamgen
 
-    if len(sys.argv) > 1:
-        wh = sys.argv[1]
+    sess = Session(catalog, backend="cpu")
+    dev_cache: dict = {}
+    ok, mism, fell = [], [], []
+    for tpl in streamgen.list_templates():
+        for name, sql in streamgen.render_template_parts(
+                str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+            try:
+                plan, _ = sess.plan(sql)
+            except Exception as e:  # planner issue, not a dist gap
+                fell.append((name, f"PLAN: {e}"))
+                continue
+            try:
+                want = physical.execute(plan, catalog).to_rows()
+            except Exception as e:  # oracle (numpy interpreter) defect
+                fell.append((name, f"ORACLE: {type(e).__name__}: {e}"))
+                continue
+            try:
+                exe = dplan.DistributedPlanExecutor(
+                    catalog, mesh,
+                    shard_threshold_rows=shard_threshold_rows,
+                    dev_cache=dev_cache)
+                got = exe.execute_plan(plan).to_rows()
+            except dplan.DistUnsupported as e:
+                fell.append((name, str(e)))
+                if verbose:
+                    print(f"  FALL {name}: {e}", flush=True)
+                continue
+            except Exception as e:
+                fell.append((name, f"ERROR {type(e).__name__}: {e}"))
+                if verbose:
+                    print(f"  ERR  {name}: {type(e).__name__}: {e}",
+                          flush=True)
+                continue
+            if rows_match(want, got):
+                ok.append(name)
+                if verbose:
+                    print(f"  OK   {name} ({len(got)} rows)", flush=True)
+            else:
+                mism.append((name, len(want), len(got)))
+                if verbose:
+                    print(f"  ROWDIFF {name}: {len(want)} vs {len(got)}",
+                          flush=True)
+    return ok, mism, fell
+
+
+def main():
+    from ndstpu.io import loader
+    from ndstpu.parallel import mesh as pmesh
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    assert_ok = "--no-assert" not in sys.argv
+    if args:
+        wh = args[0]
     else:
         tmp = tempfile.mkdtemp(prefix="spmdcov")
         data = os.path.join(tmp, "raw")
@@ -53,40 +137,17 @@ def main():
 
     catalog = loader.load_catalog(wh)
     mesh = pmesh.make_mesh(8)
-    sess = Session(catalog, backend="cpu")
+    ok, mism, fell = run_corpus(catalog, mesh)
 
-    reasons = collections.Counter()
-    ok, fell = [], []
-    for tpl in streamgen.list_templates():
-        for name, sql in streamgen.render_template_parts(
-                str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
-            try:
-                plan, _ = sess.plan(sql)
-            except Exception as e:  # planner issue, not a dist gap
-                reasons[f"PLAN: {e}"] += 1
-                fell.append((name, f"PLAN: {e}"))
-                continue
-            try:
-                dplan.execute_distributed(catalog, mesh, plan,
-                                          shard_threshold_rows=500)
-                ok.append(name)
-                print(f"  OK   {name}", flush=True)
-            except dplan.DistUnsupported as e:
-                reasons[str(e)] += 1
-                fell.append((name, str(e)))
-                print(f"  FALL {name}: {e}", flush=True)
-            except Exception as e:
-                reasons[f"ERROR {type(e).__name__}: {e}"] += 1
-                fell.append((name, f"ERROR {type(e).__name__}: {e}"))
-                print(f"  ERR  {name}: {type(e).__name__}: {e}", flush=True)
-
-    total = len(ok) + len(fell)
-    print(f"\n== {len(ok)}/{total} parts distributed ==")
+    total = len(ok) + len(mism) + len(fell)
+    print(f"\n== {len(ok)}/{total} parts distributed AND row-equal ==")
+    reasons = collections.Counter(r for _, r in fell)
     for reason, cnt in reasons.most_common():
         print(f"{cnt:4d}  {reason}")
-    print("\nfallback parts:")
-    for name, reason in fell:
-        print(f"  {name}: {reason}")
+    for name, nw, ng in mism:
+        print(f"  ROWDIFF {name}: want {nw} rows, got {ng}")
+    if assert_ok and (mism or fell):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,75 @@
+"""Full-corpus warm + steady-state timing on the current JAX platform.
+
+Phase 1 discovers+warms every query (compile at discovery), persisting
+size-plan records incrementally; phase 2 times a pure steady-state pass.
+Writes JSON to .bench_cache/warm_report_sf{SF}.json.  A per-query
+watchdog abandons a wedged compile in its daemon thread and keeps going.
+"""
+import json, os, pathlib, sys, threading, time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+SF = f"{float(os.environ.get('NDSTPU_BENCH_SF', '1')):g}"
+PER_Q = float(os.environ.get("NDSTPU_WARM_QUERY_TIMEOUT_S", "900"))
+
+import jax
+jax.config.update("jax_compilation_cache_dir",
+                  str(REPO / ".bench_cache" / "xla_cache_tpu"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+from ndstpu.engine.session import Session
+from ndstpu.io import loader
+from ndstpu.queries import streamgen
+
+catalog = loader.load_catalog(str(REPO / ".bench_cache" / f"wh_sf{SF}"))
+sess = Session(catalog, backend="tpu")
+rec = str(REPO / ".bench_cache" / f"plans_sf{SF}.pkl")
+try:
+    print("preloaded", sess.preload_compiled(rec), flush=True)
+except Exception as e:
+    print("preload failed:", e, flush=True)
+
+queries = []
+for tpl in streamgen.list_templates():
+    queries.extend(streamgen.render_template_parts(
+        str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+
+def run_one(sess, sql, slot):
+    try:
+        out = sess.sql(sql)
+        out.to_rows()
+        slot["ok"] = True
+    except Exception as e:
+        slot["err"] = f"{type(e).__name__}: {e}"
+
+report = {"discover": {}, "steady": {}, "failed": {}}
+only = set(sys.argv[1:])
+for phase in ("discover", "steady"):
+    for name, sql in queries:
+        if only and name not in only: continue
+        if name in report["failed"]: continue
+        slot = {}
+        th = threading.Thread(target=run_one, args=(sess, sql, slot), daemon=True)
+        t0 = time.time()
+        th.start(); th.join(PER_Q)
+        dt = round(time.time() - t0, 3)
+        if th.is_alive():
+            report["failed"][name] = f"hang>{PER_Q}s in {phase}"
+            print(f"{phase} {name}: HANG", flush=True)
+            sess = Session(catalog, backend="tpu")
+            try: sess.preload_compiled(rec)
+            except Exception: pass
+            continue
+        if "err" in slot:
+            report["failed"][name] = slot["err"]
+            print(f"{phase} {name}: ERR {slot['err'][:200]}", flush=True)
+            continue
+        report[phase][name] = dt
+        print(f"{phase} {name}: {dt}s", flush=True)
+        if phase == "discover":
+            try: sess.save_compiled(rec)
+            except Exception as e: print("save failed:", e, flush=True)
+    tot = sum(report[phase].values())
+    print(f"== {phase} total {tot:.1f}s over {len(report[phase])} queries ==", flush=True)
+with open(REPO / ".bench_cache" / f"warm_report_sf{SF}.json", "w") as f:
+    json.dump(report, f, indent=1)

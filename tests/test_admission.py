@@ -29,9 +29,12 @@ def test_semaphore_bounds_concurrency(tmp_path):
         p.start()
     events = []
     for _ in range(nproc * 2):
-        events.append(q.get(timeout=30))
+        # generous timeout: spawn re-imports the package per process,
+        # which can take >30 s on a loaded machine (observed flaking
+        # while a TPU warm run shared the host)
+        events.append(q.get(timeout=180))
     for p in procs:
-        p.join(timeout=30)
+        p.join(timeout=60)
     events.sort(key=lambda e: e[1])
     inside = peak = 0
     for kind, _ in events:

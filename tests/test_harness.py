@@ -177,6 +177,29 @@ def test_submit_template_layer(dataset, env, tmp_path):
     assert summary["env"]["engineConf"]["engine.interpreter"] == "numpy"
 
 
+def test_report_degradation_marks_task_failures():
+    """Any engine degradation surfaced as a warning (eager demotion,
+    size-class rediscovery, distributed fallback) must mark the query
+    CompletedWithTaskFailures in the JSON summary — the reference's
+    task-failure listener contract (PysparkBenchReport.py:89-92)."""
+    import warnings
+
+    from ndstpu.harness.report import BenchReport
+
+    def degraded_query():
+        warnings.warn("whole-query compile failed twice, demoted to "
+                      "eager per-op execution: injected")
+
+    rep = BenchReport()
+    summary = rep.report_on(degraded_query)
+    assert summary["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert any("demoted to eager" in f for f in summary["taskFailures"])
+
+    rep2 = BenchReport()
+    s2 = rep2.report_on(lambda: None)
+    assert s2["queryStatus"] == ["Completed"]
+
+
 def test_apply_engine_properties_jax_keys():
     from ndstpu.harness.power import apply_engine_properties
     import jax
