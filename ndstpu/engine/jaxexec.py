@@ -910,10 +910,8 @@ class JEval:
             return out
         if name == "coalesce":
             cols = [self.eval(a) for a in e.args]
-            tgt = cols[0].ctype
-            for c in cols[1:]:
-                if ex.is_numeric(c.ctype) and ex.is_numeric(tgt):
-                    tgt = ex.common_type(tgt, c.ctype)
+            tgt = ex.coalesce_common_type(e.args,
+                                          [c.ctype for c in cols])
             if tgt.kind == "string":
                 scols = [self.cast(c, STRING) for c in cols]
                 merged = _merged_dict(scols)
@@ -3271,10 +3269,12 @@ class CompilingExecutor(JaxExecutor):
             except KeyError:
                 return False
 
+        from ndstpu.engine.sql import normalize_sql_key
         n = 0
         for sql, ent in data.items():
             if sql.startswith("\x00"):
                 continue
+            norm = normalize_sql_key(sql)
             (record, fps, table_cols, out_meta, seg_fps, out_cap) = ent
             if not fingerprints_ok(fps):
                 continue
@@ -3304,7 +3304,7 @@ class CompilingExecutor(JaxExecutor):
                                table_cols, None, out_meta, preloaded=True)
             cp.seg_fps = list(seg_fps or ())
             cp.out_capacity = out_cap
-            self._compiled[f"{key_prefix}|{sql}"] = cp
+            self._compiled[f"{key_prefix}|{norm}"] = cp
             n += 1
         return n
 
@@ -3384,6 +3384,21 @@ class CompilingExecutor(JaxExecutor):
             try:
                 dt = self.execute(cp.plan)
                 dt = self.compact(dt)   # mirror of _discover_plan
+                # output-type guard: engine typing changes (e.g. the
+                # r04 coalesce decimal-literal fix) can retype a
+                # column without changing the PLAN tree, so a
+                # preloaded record's out_meta goes stale while its
+                # size plan still matches.  Assembling scaled-decimal
+                # data under a recorded float64 meta silently wrote
+                # x100 values — raise at trace time instead (ctypes
+                # are static here); callers rediscover.
+                rec_meta = {name: ct for name, ct, _d, _b in cp.out_meta}
+                for name, c in dt.columns.items():
+                    if rec_meta.get(name) != c.ctype:
+                        raise RuntimeError(
+                            f"size-plan drift: output column {name} "
+                            f"traced as {c.ctype}, recorded "
+                            f"{rec_meta.get(name)}")
                 ok = jnp.asarray(True)
                 for o in self._oks:
                     ok = ok & o

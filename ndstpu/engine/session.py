@@ -37,8 +37,9 @@ class Session:
 
     def sql(self, text: str) -> Optional[columnar.Table]:
         """Execute one statement; returns a Table for queries, None for DDL."""
+        from ndstpu.engine.sql import normalize_sql_key
         stmt = parse_statement(text)
-        return self._run(stmt, key=text)
+        return self._run(stmt, key=normalize_sql_key(text))
 
     def sql_script(self, text: str) -> List[Optional[columnar.Table]]:
         return [self._run(s) for s in parse_statements(text)]
@@ -63,18 +64,18 @@ class Session:
             if pc is None:
                 pc = self._plan_cache = {}
             ent = None
-            versions = None
+            state = None
             if key is not None:
-                # catalog versions validate the entry (optimizer
-                # choices read table stats, and a re-registered table
-                # may change schema) but stay OUT of the key so each
-                # query text holds exactly one slot — replace-on-
-                # mismatch like _spmd_cache, no unbounded staleness
+                # the key is the TEXT alone — one slot per query, with
+                # views epoch + catalog versions stored in the value
+                # and replace-on-mismatch (like _spmd_cache): DML or
+                # view churn must invalidate without stranding old-
+                # epoch entries forever
                 versions = tuple(sorted(
                     getattr(self.catalog, "versions", {}).items()))
-                ck = (self._views_epoch, key)
-                ent = pc.get(ck)
-                if ent is not None and ent[0] != versions:
+                state = (self._views_epoch, versions)
+                ent = pc.get(key)
+                if ent is not None and ent[0] != state:
                     ent = None
             if ent is None:
                 planner = pl.Planner(self.catalog, dict(self.views))
@@ -84,9 +85,9 @@ class Session:
                 # display names: strip alias qualifiers
                 disp = self._dedupe(planner._display_names(cols))
                 if key is not None:
-                    pc[(self._views_epoch, key)] = (versions, plan, disp)
+                    pc[key] = (state, plan, disp)
             else:
-                _v, plan, disp = ent
+                _s, plan, disp = ent
             out = self._execute(plan, key=key)
             return columnar.Table(dict(zip(disp, out.columns.values())))
         if isinstance(stmt, ast.CreateView):
@@ -232,10 +233,12 @@ class Session:
     def compiled_plan(self, text: str):
         """The cached whole-query compile record for a SQL text (or None).
         Test/introspection hook — mirrors the key used by `_execute`."""
+        from ndstpu.engine.sql import normalize_sql_key
         exe = getattr(self, "_jax_exec_cache", None)
         if exe is None:
             return None
-        return exe._compiled.get(f"{self._views_epoch}|{text}")
+        return exe._compiled.get(
+            f"{self._views_epoch}|{normalize_sql_key(text)}")
 
     def save_compiled(self, path: str) -> int:
         """Persist whole-query size-plan records for the jax backend."""

@@ -140,6 +140,15 @@ def run_query_stream(args) -> None:
         engine_conf.update(load_properties(args.property_file))
     engine_conf.setdefault("engine", args.engine)
     engine_conf.setdefault("input_format", args.input_format)
+    if getattr(args, "xla_cache_dir", None) and \
+            args.engine in ("tpu", "tpu-spmd"):
+        # persistent XLA compile cache (like bench.py): without it every
+        # power-run process pays the full per-query compile again even
+        # when size-plan records preloaded fine (observed ~30 s/query)
+        engine_conf.setdefault("jax.compilation_cache_dir",
+                               args.xla_cache_dir)
+        engine_conf.setdefault(
+            "jax.persistent_cache_min_compile_time_secs", "2.0")
     apply_engine_properties(engine_conf)
 
     query_dict = gen_sql_from_stream(args.query_stream_file)
@@ -182,6 +191,58 @@ def run_query_stream(args) -> None:
     from ndstpu.harness import admission as adm
     gate = adm.from_env()
 
+    # per-query watchdog (accel engines): a wedged remote-compile RPC
+    # or a degraded tunnel otherwise blocks the stream forever — the
+    # bench and warm drivers already abandon such queries in a daemon
+    # thread; the power CLI gets the same protection.  The abandoned
+    # thread keeps only the OLD session, so the stream continues on a
+    # fresh one (records preloaded again).
+    accel = args.engine in ("tpu", "tpu-spmd")
+    watchdog_s = float(os.environ.get(
+        "NDSTPU_POWER_QUERY_TIMEOUT_S", "1200")) if accel else 0.0
+    sess_holder = {"s": sess}
+
+    def run_guarded(q_content, query_name):
+        if watchdog_s <= 0:
+            return run_one_query(sess_holder["s"], q_content, query_name,
+                                 args.output_prefix, args.output_format)
+        import threading
+        slot: dict = {}
+
+        def work(s=sess_holder["s"]):
+            try:
+                run_one_query(s, q_content, query_name,
+                              args.output_prefix, args.output_format)
+                slot["ok"] = True
+            except Exception as e:  # noqa: BLE001
+                slot["err"] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        th.join(watchdog_s)
+        if th.is_alive():
+            old = sess_holder["s"]
+            try:
+                fresh = Session(old.catalog, backend=args.engine,
+                                views=dict(old.views),
+                                warehouse=old.warehouse)
+                fresh.spmd_threshold = old.spmd_threshold
+                fresh.spmd_chunk_rows = old.spmd_chunk_rows
+                # swap FIRST: preload failure is non-fatal, but the
+                # stream must never continue on the session the
+                # zombie thread still drives
+                sess_holder["s"] = fresh
+                if args.compile_records:
+                    fresh.preload_compiled(args.compile_records)
+            except Exception as e:  # noqa: BLE001
+                print(f"WARNING: fresh session setup after hang "
+                      f"incomplete: {e}")
+            raise TimeoutError(
+                f"{query_name} hung > {watchdog_s:.0f}s; abandoned "
+                f"(stream continues on a fresh session)")
+        if "err" in slot:
+            raise slot["err"]
+
     power_start = int(time.time())
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
@@ -198,9 +259,8 @@ def run_query_stream(args) -> None:
             gate.acquire()
             wait_ms = int((time.time() - wait_start) * 1000)
         try:
-            summary = q_report.report_on(run_one_query, sess, q_content,
-                                         query_name, args.output_prefix,
-                                         args.output_format)
+            summary = q_report.report_on(run_guarded, q_content,
+                                         query_name)
         finally:
             if gate is not None:
                 gate.release()
@@ -229,7 +289,7 @@ def run_query_stream(args) -> None:
 
     if args.compile_records and args.engine in ("tpu", "tpu-spmd"):
         try:
-            sess.save_compiled(args.compile_records)
+            sess_holder["s"].save_compiled(args.compile_records)
         except Exception as e:
             print(f"WARNING: compile records not saved: {e}")
 
@@ -276,6 +336,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "query1,query3_part1")
     p.add_argument("--extra_time_log",
                    help="secondary location for the CSV time log")
+    p.add_argument("--xla_cache_dir",
+                   default=os.environ.get("NDSTPU_XLA_CACHE_DIR"),
+                   help="persistent XLA compile-cache dir (tpu engines); "
+                   "default from NDSTPU_XLA_CACHE_DIR")
     p.add_argument("--compile_records",
                    help="path for persisted whole-query size-plan "
                         "records (skip per-query discovery on repeat "

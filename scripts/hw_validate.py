@@ -28,7 +28,12 @@ WORK = REPO / ".bench_cache" / f"hwval_{TAG}"
 
 def main():
     WORK.mkdir(parents=True, exist_ok=True)
-    env = dict(os.environ, PYTHONPATH=str(REPO))
+    # APPEND to PYTHONPATH: clobbering it would drop the host's
+    # sitecustomize dir (axon PJRT plugin registration) and the child
+    # power run could not initialize the TPU backend
+    pp = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               PYTHONPATH=f"{REPO}{os.pathsep}{pp}" if pp else str(REPO))
     stream_dir = WORK / "streams"
     subprocess.run([sys.executable, "-m", "ndstpu.queries.streamgen",
                     "--streams", "1", "--rngseed", "07291122510",
@@ -48,7 +53,9 @@ def main():
                "--json_summary_folder", str(js)]
         if engine == "tpu":
             cmd += ["--compile_records",
-                    str(REPO / ".bench_cache" / f"plans_sf{SF}.pkl")]
+                    str(REPO / ".bench_cache" / f"plans_sf{SF}.pkl"),
+                    "--xla_cache_dir",
+                    str(REPO / ".bench_cache" / "xla_cache_tpu")]
         r = subprocess.run(cmd, env=env, cwd=REPO)
         runs[engine] = {"rc": r.returncode,
                         "elapsed_s": round(time.time() - t0, 1)}

@@ -483,3 +483,19 @@ def test_groupby_direct_path_engages(catalog, monkeypatch):
     assert ngseg == (hi - lo + 1 + 1) + 1  # +NULL slot, +trash slot
     # pallas eligibility for the decimal measure column
     assert exe._pallas_sum_ok(dt.columns["ss_ext_sales_price"], ngseg)
+
+
+def test_coalesce_decimal_literal_stays_decimal(cpu_sess, tpu_sess):
+    """Spark types `0.0` as DECIMAL(1,1), so coalesce(decimal, 0.0)
+    must stay DECIMAL (exact scaled-int math on TPU) instead of
+    promoting to emulated f64 — q75's UNION-distinct drifted on real
+    hardware when the money column went through float."""
+    sql = ("select ss_item_sk, "
+           "ss_ext_sales_price - coalesce(ss_ext_discount_amt, 0.0) as x "
+           "from store_sales order by ss_item_sk, x limit 50")
+    a = cpu_sess.sql(sql)
+    b = tpu_sess.sql(sql)
+    from ndstpu.schema import DType  # noqa: F401
+    assert a.columns["x"].ctype.kind == "decimal"
+    assert b.columns["x"].ctype.kind == "decimal"
+    assert a.to_rows() == b.to_rows()

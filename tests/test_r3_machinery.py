@@ -236,3 +236,57 @@ def test_select_cols_validity_base_mismatch_no_collapse():
     want_valid = np.where(np.asarray(pick_a), np.asarray(va),
                           np.asarray(vb))
     assert (got_valid == want_valid).all()
+
+
+def test_stream_and_direct_sql_share_compiled_plan(catalog):
+    """The SAME query must hit one compiled record whether it arrives
+    as direct template text or as stream-file text carrying the
+    `-- start/end` markers and trailing semicolon (the power CLI
+    previously missed every persisted record and silently re-ran
+    eager discovery per query)."""
+    sess = _fresh_tpu_session(catalog)
+    direct = ("select count(*) as n from store_sales "
+              "where ss_quantity between 1 and 20")
+    streamed = ("-- start query 1 in stream 0 using template queryX.tpl\n"
+                + direct +
+                "\n;\n-- end query 1 in stream 0 using template queryX.tpl\n")
+    want = sess.sql(direct).to_rows()
+    exe = sess._jax_executor()
+    disc = exe.n_discoveries
+    got = sess.sql(streamed).to_rows()
+    assert got == want
+    assert exe.n_discoveries == disc, \
+        "stream-marker text missed the compiled-plan cache"
+    assert sess.compiled_plan(direct) is sess.compiled_plan(streamed)
+
+
+def test_stale_out_meta_self_heals(catalog, tmp_path):
+    """An engine typing change can retype an output column without
+    changing the plan tree, leaving a preloaded record's out_meta
+    stale; assembling under the stale meta silently corrupted values
+    (r04: scaled decimal data written as x100 floats).  The replay
+    trace must detect the ctype drift and rediscover."""
+    from ndstpu.schema import FLOAT64
+    s1 = _fresh_tpu_session(catalog)
+    sql = ("select i_category, sum(ss_net_paid) as s from store_sales "
+           "join item on ss_item_sk = i_item_sk group by i_category "
+           "order by i_category")
+    want = s1.sql(sql).to_rows()
+    path = str(tmp_path / "plans.pkl")
+    assert s1.save_compiled(path) >= 1
+    s2 = _fresh_tpu_session(catalog)
+    assert s2.preload_compiled(path) >= 1
+    exe2 = s2._jax_executor()
+    cp = s2.compiled_plan(sql)
+    assert cp is not None and cp.preloaded
+    # simulate a typing change since the record was saved: claim the
+    # decimal sum column was float64
+    cp.out_meta = [(n, (FLOAT64 if n == "s" else ct), d, b)
+                   for n, ct, d, b in cp.out_meta]
+    for fp in (cp.seg_fps or ()):
+        scp = exe2._seg_compiled[fp]
+        scp.out_meta = [(n, (FLOAT64 if n == "s" else ct), d, b)
+                        for n, ct, d, b in scp.out_meta]
+    got = s2.sql(sql).to_rows()
+    assert got == want, "stale out_meta produced corrupted values"
+    assert exe2.n_discoveries > 0, "drifted meta did not self-heal"
