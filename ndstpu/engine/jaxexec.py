@@ -40,6 +40,7 @@ to host once).
 
 from __future__ import annotations
 
+import os
 import dataclasses
 import math
 import re
@@ -376,9 +377,17 @@ def _table_content_fp(t) -> str:
     Memoized on the Table (immutable once inlined): _plan_fp runs at
     every memo node, and re-CRCing a large inline table per node would
     turn an O(1) lookup into O(bytes)."""
+    # memo token guards against mutation after first fingerprinting: a
+    # stale fp would silently key segment reuse and persisted compile
+    # records, so the memo is only honored while the table still holds
+    # the SAME column objects (identity, with strong refs held — bare
+    # id()s could be recycled after GC) and row count
+    token = (t.num_rows, tuple(t.columns.values()))
     cached = getattr(t, "_content_fp", None)
-    if cached is not None:
-        return cached
+    if cached is not None and cached[0][0] == token[0] and \
+            len(cached[0][1]) == len(token[1]) and \
+            all(a is b for a, b in zip(cached[0][1], token[1])):
+        return cached[1]
     import zlib
     parts = []
     for name in t.column_names:
@@ -397,7 +406,7 @@ def _table_content_fp(t) -> str:
         parts.append(f"{name}:{c.ctype!r}:{data.dtype}{data.shape}:{crc}")
     fp = f"T({t.num_rows};" + ";".join(parts) + ")"
     try:
-        t._content_fp = fp
+        t._content_fp = (token, fp)
     except (AttributeError, TypeError):
         pass  # slotted/frozen table: recompute next time
     return fp
@@ -1218,6 +1227,10 @@ class JaxExecutor:
         # re-run NO discovery and build NO new jitted programs
         self.n_discoveries = 0
         self.n_jit_builds = 0
+        # eager bounds diagnostic: plain (non-compiling) executors keep
+        # it always on — they have no discovery phase to front-load the
+        # check into; CompilingExecutor narrows it to discovery
+        self._in_discovery = True
 
     # -- public --------------------------------------------------------------
 
@@ -1587,6 +1600,12 @@ class JaxExecutor:
         nodes the rewrite can't walk — the caller falls back to
         per-set full passes.
         """
+        # dedup key is _plan_fp, NOT repr: AggExpr.__repr__ delegates to
+        # arg reprs and Literal's repr hides its ctype, so two agg
+        # expressions differing only in literal type would collide and
+        # share one partial column.  NOTE the two-stage sum reorders
+        # float64 summation vs the per-set direct path; the differential
+        # harness epsilon (1e-5 relative) covers that drift.
         leaves: Dict[str, ex.AggExpr] = {}
         for _name, e in p.aggs:
             for node in e.walk():
@@ -1594,7 +1613,7 @@ class JaxExecutor:
                     if node.distinct or \
                             node.func not in self._GS_COMBINABLE:
                         return None
-                    leaves.setdefault(repr(node), node)
+                    leaves.setdefault(_plan_fp(node), node)
         # finest-grain partials: sum+count for sum/avg, the func itself
         # for count/min/max (counts recombine by sum, min/max by
         # min/max; sum-of-sums preserves NULL-iff-no-valid-rows because
@@ -1631,7 +1650,7 @@ class JaxExecutor:
 
         def rebuild(node: ex.Expr) -> ex.Expr:
             if isinstance(node, ex.AggExpr):
-                return combine[repr(node)]
+                return combine[_plan_fp(node)]
             if isinstance(node, ex.BinOp):
                 return ex.BinOp(node.op, rebuild(node.left),
                                 rebuild(node.right))
@@ -1776,13 +1795,21 @@ class JaxExecutor:
             # the replay guard so the query rediscovers (and the eager
             # pass below warns) instead of silently dropping rows
             self._oks.append(~jnp.any(bad))
-        elif bool(jnp.any(bad)):
-            import warnings
-            warnings.warn(
-                f"group-by bounds invariant violated: "
-                f"{int(jnp.sum(bad))} valid rows fell outside static "
-                f"key bounds and were dropped (upstream bounds-"
-                f"propagation bug)", stacklevel=2)
+        elif self._in_discovery or \
+                os.environ.get("NDSTPU_DEBUG_BOUNDS", "0") not in ("", "0"):
+            # the bool() forces a blocking device sync — pay it during
+            # discovery (which covers demoted-to-eager subtrees too:
+            # every query's FIRST execution passes through
+            # _discover_plan, so bugs surface then), not on every
+            # steady-state demoted eager aggregate.  NDSTPU_DEBUG_BOUNDS
+            # restores the per-execution check.
+            if bool(jnp.any(bad)):
+                import warnings
+                warnings.warn(
+                    f"group-by bounds invariant violated: "
+                    f"{int(jnp.sum(bad))} valid rows fell outside static "
+                    f"key bounds and were dropped (upstream bounds-"
+                    f"propagation bug)", stacklevel=2)
         gid = jnp.where(alive & row_ok, gid, domain)
         ngseg = domain + 1
         counts = jax.ops.segment_sum(alive.astype(jnp.int32), gid,
@@ -2901,6 +2928,13 @@ class CompilingExecutor(JaxExecutor):
     version changes trigger rediscovery.
     """
 
+    def __init__(self, catalog):
+        super().__init__(catalog)
+        # the eager bounds diagnostic syncs the device; pay it only
+        # inside discovery (every query's first execution), not on
+        # steady-state demoted eager aggregates
+        self._in_discovery = False
+
     def execute_cached(self, p: lp.Plan, key: str) -> Table:
         versions = tuple(sorted(
             getattr(self.catalog, "versions", {}).items()))
@@ -3136,6 +3170,7 @@ class CompilingExecutor(JaxExecutor):
         self._tree_cache = {}
         self.np_exec = physical.Executor(self.catalog)
         self.mode = "discover"
+        self._in_discovery = True
         self._rec = []
         self._used_fallback = False
         try:
@@ -3150,6 +3185,7 @@ class CompilingExecutor(JaxExecutor):
                 dt = self.compact(dt)
         finally:
             self.mode = "eager"
+            self._in_discovery = False
         cp = _CompiledPlan(p, not self._used_fallback, self._rec, versions)
         cp.table_cols = _scan_columns(p)
         cp.out_capacity = dt.capacity

@@ -32,23 +32,68 @@ from ndstpu.harness.report import BenchReport
 from ndstpu.io import loader
 
 
+# One `-- start query N in stream M using template queryX.tpl` marker
+# opens each query block (the spark.tpl dialect contract the stream
+# generator reproduces; cf. reference nds_power.py:49-76).
+_STREAM_MARKER = re.compile(
+    r"^--\s*start\s+query\s+\d+\s+in\s+stream\s+\d+\s+using\s+template\s+"
+    r"(?P<name>\w+)\.tpl\s*$",
+    re.MULTILINE | re.IGNORECASE)
+
+
+def _sql_statements(block: str) -> List[str]:
+    """Non-empty SQL statements in a query block, split on semicolons
+    that are real statement terminators — a ``;`` inside a quoted
+    literal or a ``--`` line comment does not split.  Fragments with no
+    code outside comments (e.g. the trailing ``-- end query`` marker
+    after the final semicolon) are not statements."""
+    frags: List[str] = []
+    cur: List[str] = []
+    has_code = False
+    in_str = in_comment = False
+    for i, ch in enumerate(block):
+        if in_comment:
+            in_comment = ch != "\n"
+        elif in_str:
+            in_str = ch != "'"
+        elif ch == "'":
+            in_str = True
+            has_code = True
+        elif ch == "-" and block[i + 1:i + 2] == "-":
+            in_comment = True
+        elif ch == ";":
+            if has_code:
+                frags.append("".join(cur))
+            cur, has_code = [], False
+            continue
+        elif not ch.isspace():
+            has_code = True
+        cur.append(ch)
+    if has_code:
+        frags.append("".join(cur))
+    return frags
+
+
 def gen_sql_from_stream(query_stream_file_path: str) -> "OrderedDict[str, str]":
-    """Split a stream file into {query_name: sql}, splitting two-part
-    queries into `_part1`/`_part2` (contract: nds_power.py:49-76)."""
+    """Split a stream file into {query_name: sql}, splitting the
+    multi-statement templates (14/23/24/39) into `_part1`/`_part2`
+    entries (contract: nds_power.py:49-76)."""
     with open(query_stream_file_path) as f:
-        stream = f.read()
-    all_queries = stream.split("-- start")[1:]
-    extended = OrderedDict()
-    for q in all_queries:
-        name = q[q.find("template") + 9:q.find(".tpl")]
-        body = q.split(";")
-        if len(body) > 2 and "select" in body[1].lower():
-            head = body[0].split("\n", 1)
-            extended[name + "_part1"] = head[1] + ";"
-            extended[name + "_part2"] = body[1] + ";"
+        text = f.read()
+    markers = list(_STREAM_MARKER.finditer(text))
+    queries: "OrderedDict[str, str]" = OrderedDict()
+    for marker, nxt in zip(markers, markers[1:] + [None]):
+        name = marker.group("name")
+        block_end = nxt.start() if nxt is not None else len(text)
+        body = text[marker.end():block_end]
+        stmts = _sql_statements(body)
+        if len(stmts) > 1:
+            for k, stmt in enumerate(stmts, start=1):
+                queries[f"{name}_part{k}"] = stmt + ";"
         else:
-            extended[name] = "-- start" + q
-    return extended
+            # single-statement: keep the whole block, markers included
+            queries[name] = text[marker.start():block_end]
+    return queries
 
 
 def ensure_valid_column_names(table: columnar.Table) -> columnar.Table:
@@ -197,10 +242,31 @@ def run_query_stream(args) -> None:
     # thread; the power CLI gets the same protection.  The abandoned
     # thread keeps only the OLD session, so the stream continues on a
     # fresh one (records preloaded again).
+    #
+    # Device-sharing hazard: the abandoned thread still drives the old
+    # session on the SAME TPU runtime the fresh session uses; a late
+    # completion can contend for HBM, and warnings it raises are
+    # captured by whichever later query's report window is open
+    # (process-global warning capture).  Mitigation below: abandoned
+    # threads are tracked in `zombies`; before each query the stream
+    # grants them a short grace join, and any still-alive zombie is
+    # recorded in the query's summary (`zombieQueries`) so a
+    # CompletedWithTaskFailures status can be adjudicated.
     accel = args.engine in ("tpu", "tpu-spmd")
     watchdog_s = float(os.environ.get(
         "NDSTPU_POWER_QUERY_TIMEOUT_S", "1200")) if accel else 0.0
     sess_holder = {"s": sess}
+    zombies: List[dict] = []  # abandoned runs: {th, name, graced}
+
+    def live_zombies(grace_s: float = 0.0) -> List[str]:
+        # each zombie gets ONE grace join — a permanently-wedged thread
+        # must not charge every remaining query the full grace window
+        for z in zombies:
+            if not z["graced"]:
+                z["th"].join(grace_s)
+                z["graced"] = True
+        zombies[:] = [z for z in zombies if z["th"].is_alive()]
+        return [z["name"] for z in zombies]
 
     def run_guarded(q_content, query_name):
         if watchdog_s <= 0:
@@ -221,6 +287,7 @@ def run_query_stream(args) -> None:
         th.start()
         th.join(watchdog_s)
         if th.is_alive():
+            zombies.append({"th": th, "name": query_name, "graced": False})
             old = sess_holder["s"]
             try:
                 fresh = Session(old.catalog, backend=args.engine,
@@ -246,6 +313,13 @@ def run_query_stream(args) -> None:
     power_start = int(time.time())
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
+        # abandoned-thread gate: give zombies a short grace window to
+        # drain before sharing the device with the next query
+        active_zombies = live_zombies(grace_s=10.0) if zombies else []
+        if active_zombies:
+            print(f"WARNING: abandoned query threads still running: "
+                  f"{active_zombies} — device contention possible; "
+                  f"captured warnings may belong to them")
         q_report = BenchReport(engine_conf)
         # NOTE metric difference vs the reference: its concurrentGpuTasks
         # semaphore is acquired inside task execution, so queue wait is
@@ -266,6 +340,8 @@ def run_query_stream(args) -> None:
                 gate.release()
         if gate is not None:
             summary["admissionWaitMs"] = wait_ms
+        if active_zombies:
+            summary["zombieQueries"] = active_zombies
         print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
         execution_times.append((app_id, query_name,
                                 summary["queryTimes"][0]))

@@ -25,6 +25,7 @@ import glob
 import json
 import math
 import os
+import re
 from decimal import Decimal
 from typing import List, Optional
 
@@ -34,9 +35,74 @@ from ndstpu.harness.power import gen_sql_from_stream
 
 SKIP_QUERIES = {"query65"}
 SKIP_FLOAT_QUERIES = {"query67"}
-# queries with a rounding-unstable ratio column (reference q78 semantics)
-ROUND_UNSTABLE = {"query78": [12]}
+# queries carrying a rounding-unstable `ratio` column whose position is
+# located per stream from the SQL text (reference q78 semantics,
+# nds_validate.py:146-192 — the column can sit at different positions in
+# different streams/engines, so it must not be hardcoded)
+ROUND_UNSTABLE_QUERIES = {"query78"}
 ROUND_EPSILON = 0.01001
+
+
+def _outer_select_items(sql: str) -> List[str]:
+    """Split the final top-level SELECT list into its expressions,
+    respecting parentheses (``round(a/(b+c),2) ratio`` is ONE item).
+    The outer select is the LAST ``select`` at paren depth 0 — selects
+    inside CTE bodies, derived tables, or scalar subqueries all sit
+    inside parentheses and are skipped."""
+    low = sql.lower()
+    start = -1
+    depth = 0
+    for m in re.finditer(r"[()]|\bselect\b", low):
+        tok = m.group(0)
+        if tok == "(":
+            depth += 1
+        elif tok == ")":
+            depth -= 1
+        elif depth == 0:
+            start = m.start()
+    if start < 0:
+        return []
+    items: List[str] = []
+    buf: List[str] = []
+    depth = 0
+    i = start + len("select")
+    while i < len(sql):
+        ch = sql[i]
+        if depth == 0 and low.startswith("from", i) and \
+                not (low[i - 1].isalnum() or low[i - 1] == "_") and \
+                (i + 4 == len(sql) or
+                 not (low[i + 4].isalnum() or low[i + 4] == "_")):
+            break
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            items.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    if buf and "".join(buf).strip():
+        items.append("".join(buf).strip())
+    return items
+
+
+def locate_unstable_cols(query_name: str,
+                         sql: Optional[str]) -> Optional[List[int]]:
+    """0-based positions of rounding-unstable output columns, found from
+    the query text (dynamic per stream — reference
+    check_nth_col_problematic_q78, nds_validate.py:146-165)."""
+    base = query_name.split("_part")[0]
+    if base not in ROUND_UNSTABLE_QUERIES or not sql:
+        return None
+    idxs = [i for i, item in enumerate(_outer_select_items(sql))
+            if "ratio" in item.lower()]
+    if not idxs:
+        raise ValueError(
+            f"{query_name}: no `ratio` column found in the final select "
+            f"list — cannot locate the rounding-unstable column")
+    return idxs
 
 
 def _read_output(path: str):
@@ -115,9 +181,11 @@ def row_equal(ra, rb, epsilon: float,
 def compare_results(path_a: str, path_b: str, query_name: str,
                     ignore_ordering: bool, epsilon: float = 1e-5,
                     use_decimal: bool = True,
-                    max_errors: int = 10) -> bool:
+                    max_errors: int = 10,
+                    query_sql: Optional[str] = None) -> bool:
     """Compare one query's two output dirs (reference:
-    nds_validate.py:48-114)."""
+    nds_validate.py:48-114).  `query_sql` (the stream's rendered text)
+    drives positional detection of rounding-unstable columns."""
     if query_name in SKIP_QUERIES:
         print(f"=== Skipping {query_name} (documented carve-out) ===")
         return True
@@ -129,7 +197,7 @@ def compare_results(path_a: str, path_b: str, query_name: str,
     if len(a) != len(b):
         print(f"[{query_name}] row count mismatch: {len(a)} vs {len(b)}")
         return False
-    unstable = ROUND_UNSTABLE.get(query_name)
+    unstable = locate_unstable_cols(query_name, query_sql)
     errors = 0
     for i, (ra, rb) in enumerate(zip(a, b)):
         if not row_equal(ra, rb, epsilon, unstable):
@@ -155,10 +223,17 @@ def iterate_queries(args) -> List[str]:
         try:
             ok = compare_results(pa_, pb_, q, args.ignore_ordering,
                                  args.epsilon, not args.floats,
-                                 args.max_errors)
+                                 args.max_errors,
+                                 query_sql=query_dict.get(q))
             status = "Pass" if ok else "Fail"
         except FileNotFoundError as e:
             print(f"[{q}] missing output: {e}")
+            ok = False
+        except ValueError as e:
+            # e.g. unstable-column detection failed on a malformed q78
+            # stream entry — fail THIS query, keep validating the rest
+            print(f"[{q}] validation error: {e}")
+            status = "Fail"
             ok = False
         if not ok:
             failures.append(q)

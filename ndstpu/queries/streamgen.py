@@ -23,9 +23,16 @@ Templates declare parameters in a header line per parameter:
                                            reference nds/tpcds-gen/
                                            patches/templates.patch
                                            `distmember(fips_county,...)`)
-    --@ define NAME = distlist(dname, k)   k DISTINCT weighted picks,
-                                           substituted as [NAME.1] ..
-                                           [NAME.k]
+    --@ define NAME = distlist(dname, k)   k INDEPENDENT weighted picks
+                                           (WITH replacement — dsqgen's
+                                           distmember over independent
+                                           [N.i] draws; the reference
+                                           query16 deliberately repeats
+                                           hot counties), substituted
+                                           as [NAME.1] .. [NAME.k]
+    --@ define NAME = distlistu(dname, k)  k DISTINCT weighted picks
+                                           (dsqgen `ulist` analog —
+                                           query34's county list)
 ``[NAME]`` occurrences in the body are substituted.  Arithmetic like
 ``[NAME] + 10`` stays in SQL.
 """
@@ -43,7 +50,7 @@ from typing import Dict, List, Optional, Tuple
 TEMPLATE_DIR = Path(__file__).resolve().parent / "templates"
 
 _DEFINE_RE = re.compile(
-    r"^--@\s*define\s+(\w+)\s*=\s*(uniform|choice|dist|distlist)"
+    r"^--@\s*define\s+(\w+)\s*=\s*(uniform|choice|dist|distlistu|distlist)"
     r"\((.*)\)\s*$")
 
 # Named weighted value distributions — the dsqgen distribution-table
@@ -68,18 +75,23 @@ _DISTRIBUTIONS: Dict[str, List[Tuple[str, int]]] = {
 }
 
 
-def _dist_pick(rng: random.Random, dname: str, k: int = 1) -> List[str]:
-    """k distinct weighted picks from a named distribution."""
+def _dist_pick(rng: random.Random, dname: str, k: int = 1,
+               distinct: bool = False) -> List[str]:
+    """k weighted picks from a named distribution.  Default is WITH
+    replacement (dsqgen distmember over independent draws — duplicates
+    are intentional and concentrate selectivity on hot values);
+    ``distinct=True`` removes each pick from the pool (ulist)."""
     pool = list(_DISTRIBUTIONS[dname])
     out = []
-    for _ in range(min(k, len(pool))):
+    for _ in range(min(k, len(pool)) if distinct else k):
         total = sum(w for _, w in pool)
         x = rng.randrange(total)
         for i, (v, w) in enumerate(pool):
             x -= w
             if x < 0:
                 out.append(v)
-                del pool[i]
+                if distinct:
+                    del pool[i]
                 break
     return out
 
@@ -119,8 +131,9 @@ def render_template(template_path: str, rngseed: str, stream: int) -> str:
             v = str(rng.randint(int(vals[0]), int(vals[1])))
         elif kind == "dist":
             v = _dist_pick(rng, vals[0])[0]
-        elif kind == "distlist":
-            picks = _dist_pick(rng, vals[0], int(vals[1]))
+        elif kind in ("distlist", "distlistu"):
+            picks = _dist_pick(rng, vals[0], int(vals[1]),
+                               distinct=(kind == "distlistu"))
             for i, p in enumerate(picks, 1):
                 body = body.replace(f"[{name}.{i}]", p)
             continue

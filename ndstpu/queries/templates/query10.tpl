@@ -1,6 +1,6 @@
 --@ define YEAR = uniform(1999, 2002)
 --@ define MONTH = uniform(1, 4)
---@ define COUNTY = distlist(fips_county, 5)
+--@ define COUNTY = distlistu(fips_county, 5)
 select
   cd_gender,
   cd_marital_status,

@@ -1,6 +1,6 @@
 --@ define YEAR = uniform(1998, 2000)
 --@ define BP = choice('>10000', '1001-5000')
---@ define COUNTY = distlist(fips_county, 8)
+--@ define COUNTY = distlistu(fips_county, 8)
 select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
        ss_ticket_number, cnt
 from (select ss_ticket_number, ss_customer_sk, count(*) cnt

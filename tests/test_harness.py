@@ -236,6 +236,59 @@ def test_gen_sql_from_stream_contract(tmp_path):
     assert list(q) == ["query96", "query14_part1", "query14_part2"]
 
 
+def test_gen_sql_from_stream_keeps_sql_and_markers(tmp_path):
+    stream = tmp_path / "s.sql"
+    stream.write_text(
+        "-- start query 1 in stream 3 using template query5.tpl\n"
+        "select a, b -- trailing comment with ; nothing\n"
+        "from store_sales\n;\n"
+        "-- end query 1 in stream 3 using template query5.tpl\n")
+    q = gen_sql_from_stream(str(stream))
+    assert list(q) == ["query5"]
+    # single-statement blocks keep the full text, markers included
+    assert q["query5"].startswith("-- start query 1 in stream 3")
+    assert "from store_sales" in q["query5"]
+
+
+def test_locate_unstable_cols_positional():
+    from ndstpu.harness.validate import locate_unstable_cols
+    sql = ("with x as (select 1 from t) select ss_customer_sk,\n"
+           "round(ss_qty/(coalesce(ws_qty,0)+coalesce(cs_qty,0)),2) ratio,\n"
+           "ss_qty store_qty\nfrom x")
+    assert locate_unstable_cols("query78", sql) == [1]
+    # a different layout moves the detected position with it
+    sql2 = ("select a, b, c, round(x/(y+z),2) ratio from t")
+    assert locate_unstable_cols("query78", sql2) == [3]
+    # non-carve-out queries never get unstable columns
+    assert locate_unstable_cols("query5", sql2) is None
+    # missing ratio column in a q78 stream is an error, not a silent skip
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        locate_unstable_cols("query78", "select a, b from t")
+
+
+def test_locate_unstable_cols_on_real_template(tmp_path):
+    from ndstpu.harness.validate import locate_unstable_cols
+    from ndstpu.queries import streamgen
+    sql = streamgen.render_template(
+        str(streamgen.TEMPLATE_DIR / "query78.tpl"), "42", 0)
+    assert locate_unstable_cols("query78", sql) == [1]
+
+
+def test_distlist_with_replacement_and_distinct():
+    import random as _random
+    from ndstpu.queries.streamgen import _dist_pick
+    rng = _random.Random(7)
+    # with replacement: hot values repeat across a long draw
+    picks = _dist_pick(rng, "fips_county", 40)
+    assert len(picks) == 40
+    assert len(set(picks)) < 40  # duplicates present (distmember analog)
+    # distinct mode: no repeats, capped at pool size
+    rng = _random.Random(7)
+    upicks = _dist_pick(rng, "fips_county", 8, distinct=True)
+    assert len(upicks) == 8 and len(set(upicks)) == 8
+
+
 def test_ensure_valid_column_names():
     from ndstpu.engine.columnar import INT32, Column, Table
     t = Table({"ok_name": Column(np.zeros(1, np.int32), INT32),
