@@ -301,9 +301,18 @@ static std::string bkey(int64_t sk) {
 }
 
 // ---------------------------------------------------------------------------
-// Scaling model.  SF == gigabytes, like dsdgen -scale.  Fact tables scale
-// linearly; customer-ish dims scale ~sqrt; small dims fixed (TPC-DS-like
-// SF1 cardinalities).
+// Scaling model.  SF == gigabytes, like dsdgen -scale.  Row counts follow
+// the published TPC-DS row-count step table (spec Table 3-2) at the step
+// scale factors 1/10/100/1000 — the same table dsdgen's -scale implements
+// (the reference wraps dsdgen at nds/tpcds-gen/src/main/java/org/notmysock/
+// tpcds/GenTable.java:49-167).  The step table is NOT a smooth curve:
+// item jumps 18,000 -> 102,000 at SF10, customer 100,000 -> 500,000,
+// web_site is even non-monotonic (42 at SF10, 24 at SF100) — a lin/sqrt
+// heuristic silently changes the workload above SF1.
+// Between steps: facts interpolate linearly in SF, dims geometrically
+// (log-scale across each decade); below SF1 both shrink from the SF1
+// anchor (facts linear, dims damped) so tiny test datasets keep their
+// proportions; above SF1000 the last segment extrapolates.
 // ---------------------------------------------------------------------------
 
 struct Sizes {
@@ -321,38 +330,93 @@ static int64_t lin(double sf, int64_t base) {
   int64_t v = (int64_t)llround(base * sf);
   return v < 1 ? 1 : v;
 }
-static int64_t sqr(double sf, int64_t base) {
-  double f = sf < 1.0 ? sf : sqrt(sf);
-  int64_t v = (int64_t)llround(base * (sf < 1.0 ? (0.1 + 0.9 * sf) : f));
-  return v < 1 ? 1 : v;
+
+// one table's published row counts at SF 1 / 10 / 100 / 1000
+struct Steps {
+  int64_t s1, s10, s100, s1000;
+};
+
+static int64_t step_count(double sf, const Steps& t, bool fact) {
+  if (sf < 1.0) {
+    double f = fact ? sf : (0.1 + 0.9 * sf);
+    int64_t v = (int64_t)llround((double)t.s1 * f);
+    return v < 1 ? 1 : v;
+  }
+  const double xs[4] = {1.0, 10.0, 100.0, 1000.0};
+  const double ys[4] = {(double)t.s1, (double)t.s10, (double)t.s100,
+                        (double)t.s1000};
+  if (sf >= 1000.0) {
+    double v = fact ? ys[3] * (sf / 1000.0)
+                    : ys[3] * pow(ys[3] / ys[2], log10(sf / 1000.0));
+    return (int64_t)llround(v);
+  }
+  int i = sf < 10.0 ? 0 : (sf < 100.0 ? 1 : 2);
+  double v;
+  if (sf == xs[i]) {
+    v = ys[i];
+  } else if (fact) {
+    double w = (sf - xs[i]) / (xs[i + 1] - xs[i]);
+    v = ys[i] + w * (ys[i + 1] - ys[i]);
+  } else {
+    double w = log10(sf / xs[i]);  // 0..1 across the decade
+    v = ys[i] * pow(ys[i + 1] / ys[i], w);
+  }
+  int64_t r = (int64_t)llround(v);
+  return r < 1 ? 1 : r;
 }
 
 static Sizes compute_sizes(double sf) {
+  // TPC-DS spec Table 3-2 row counts, columns SF1 / SF10 / SF100 / SF1000
+  static const Steps kStoreSales = {2880404, 28800991, 287997024,
+                                    2879987999};
+  static const Steps kCatalogSales = {1441548, 14401261, 143997065,
+                                      1439980416};
+  static const Steps kWebSales = {719384, 7197566, 72001237, 720000376};
+  static const Steps kStoreReturns = {287514, 2875432, 28795080,
+                                      287999764};
+  static const Steps kCatalogReturns = {144067, 1439749, 14404374,
+                                        143996756};
+  static const Steps kWebReturns = {71763, 719217, 7197670, 71997522};
+  static const Steps kItem = {18000, 102000, 204000, 300000};
+  static const Steps kCustomer = {100000, 500000, 2000000, 12000000};
+  static const Steps kCustomerAddress = {50000, 250000, 1000000, 6000000};
+  static const Steps kStore = {12, 102, 402, 1002};
+  static const Steps kWarehouse = {5, 10, 15, 20};
+  static const Steps kWebPage = {60, 200, 2040, 3000};
+  static const Steps kPromotion = {300, 500, 1000, 1500};
+  static const Steps kCallCenter = {6, 24, 30, 42};
+  static const Steps kWebSite = {30, 42, 24, 54};
+  static const Steps kCatalogPage = {11718, 12000, 20400, 30000};
+  static const Steps kReason = {35, 45, 55, 65};
   Sizes z;
   z.sf = sf;
-  z.store_sales = lin(sf, 2880404);
-  z.catalog_sales = lin(sf, 1441548);
-  z.web_sales = lin(sf, 719384);
-  z.store_returns = z.store_sales / 10;
-  z.catalog_returns = z.catalog_sales / 10;
-  z.web_returns = z.web_sales / 18;
-  z.item = sqr(sf, 18000);
-  z.warehouse = sf >= 100 ? 10 : 5;
+  z.store_sales = step_count(sf, kStoreSales, true);
+  z.catalog_sales = step_count(sf, kCatalogSales, true);
+  z.web_sales = step_count(sf, kWebSales, true);
+  z.store_returns = step_count(sf, kStoreReturns, true);
+  z.catalog_returns = step_count(sf, kCatalogReturns, true);
+  z.web_returns = step_count(sf, kWebReturns, true);
+  z.item = step_count(sf, kItem, false);
+  z.warehouse = step_count(sf, kWarehouse, false);
   z.inv_weeks = 261;  // weekly snapshots over the 5-year window
+  // inventory == weeks x (item/2) x warehouse; at the step SFs this
+  // reproduces the published counts exactly (e.g. 261*51,000*10 =
+  // 133,110,000 at SF10) and stays consistent with item/warehouse
+  // in between
   z.inventory = z.inv_weeks * (z.item / 2 < 1 ? 1 : z.item / 2) * z.warehouse;
-  z.customer = sqr(sf, 100000);
-  z.customer_address = sqr(sf, 50000);
+  z.customer = step_count(sf, kCustomer, false);
+  z.customer_address = step_count(sf, kCustomerAddress, false);
   z.customer_demographics = 1920800;
   z.household_demographics = 7200;
   z.income_band = 20;
-  z.store = sqr(sf, 12);
-  z.web_site = sf >= 100 ? 60 : 30;
-  z.web_page = sqr(sf, 60);
-  z.promotion = sqr(sf, 300);
-  z.catalog_page = 11718;
-  z.call_center = sf >= 100 ? 12 : 6;
+  z.store = step_count(sf, kStore, false);
+  z.web_site = step_count(sf, kWebSite, false);
+  z.web_page = step_count(sf, kWebPage, false);
+  z.promotion = step_count(sf, kPromotion, false);
+  z.catalog_page = step_count(sf, kCatalogPage, false);
+  z.call_center = step_count(sf, kCallCenter, false);
   z.ship_mode = 20;
-  z.reason = 35;
+  z.reason = step_count(sf, kReason, false);
   z.time_dim = 86400;
   z.date_dim = DATE_DIM_ROWS;
   return z;
@@ -1466,6 +1530,38 @@ int main(int argc, char** argv) {
       return argv[++i];
     };
     if (a == "-scale") sf = atof(need("-scale"));
+    else if (a == "-sizes") {
+      // print the scaling model for a scale factor (no generation) —
+      // lets tests lock the spec step-table counts cheaply
+      Sizes s = compute_sizes(atof(need("-sizes")));
+      printf("store_sales|%lld\n", (long long)s.store_sales);
+      printf("catalog_sales|%lld\n", (long long)s.catalog_sales);
+      printf("web_sales|%lld\n", (long long)s.web_sales);
+      printf("store_returns|%lld\n", (long long)s.store_returns);
+      printf("catalog_returns|%lld\n", (long long)s.catalog_returns);
+      printf("web_returns|%lld\n", (long long)s.web_returns);
+      printf("inventory|%lld\n", (long long)s.inventory);
+      printf("item|%lld\n", (long long)s.item);
+      printf("customer|%lld\n", (long long)s.customer);
+      printf("customer_address|%lld\n", (long long)s.customer_address);
+      printf("customer_demographics|%lld\n",
+             (long long)s.customer_demographics);
+      printf("household_demographics|%lld\n",
+             (long long)s.household_demographics);
+      printf("income_band|%lld\n", (long long)s.income_band);
+      printf("store|%lld\n", (long long)s.store);
+      printf("warehouse|%lld\n", (long long)s.warehouse);
+      printf("web_site|%lld\n", (long long)s.web_site);
+      printf("web_page|%lld\n", (long long)s.web_page);
+      printf("promotion|%lld\n", (long long)s.promotion);
+      printf("catalog_page|%lld\n", (long long)s.catalog_page);
+      printf("call_center|%lld\n", (long long)s.call_center);
+      printf("ship_mode|%lld\n", (long long)s.ship_mode);
+      printf("reason|%lld\n", (long long)s.reason);
+      printf("time_dim|%lld\n", (long long)s.time_dim);
+      printf("date_dim|%lld\n", (long long)s.date_dim);
+      return 0;
+    }
     else if (a == "-dir") dir = need("-dir");
     else if (a == "-table") only_table = need("-table");
     else if (a == "-parallel") parallel = atoi(need("-parallel"));
@@ -1474,7 +1570,9 @@ int main(int argc, char** argv) {
     else if (a == "-seed") g_seed = (uint64_t)atoll(need("-seed"));
     else if (a == "-h" || a == "--help") {
       printf("usage: ndsgen -scale SF -dir DIR [-parallel N -child I] "
-             "[-table T] [-update K] [-seed S]\n");
+             "[-table T] [-update K] [-seed S] | -sizes SF\n"
+             "  -sizes SF  print the row-count scaling model (spec step "
+             "table) and exit\n");
       return 0;
     } else {
       fprintf(stderr, "ndsgen: unknown arg %s\n", a.c_str());

@@ -157,7 +157,10 @@ def render_template_parts(template_path: str, rngseed: str,
     name = Path(template_path).name
     base = name[:-4] if name.endswith(".tpl") else name
     sql = render_template(template_path, rngseed, stream)
-    stmts = [s.strip() for s in sql.split(";") if s.strip()]
+    # the SAME statement splitter the power runner parses streams with —
+    # the two sides must agree on part naming
+    from ndstpu.harness.power import _sql_statements
+    stmts = [s.strip() for s in _sql_statements(sql)]
     if len(stmts) <= 1:
         return [(base, sql)]
     return [(f"{base}_part{k}", stmt + ";")
