@@ -28,6 +28,46 @@ def check_version() -> None:
 _DATAGEN_DIR = Path(__file__).resolve().parent / "datagen"
 _NDSGEN_SRC = _DATAGEN_DIR / "ndsgen.cpp"
 _NDSGEN_BIN = _DATAGEN_DIR / "_build" / "ndsgen"
+_DISTS_JSON = _DATAGEN_DIR / "dists.json"
+_DISTS_HEADER = _DATAGEN_DIR / "_build" / "dists_gen.h"
+
+
+def render_dists_header() -> Path:
+    """Render dists.json into the C++ header the generator compiles
+    against — the one mechanism keeping data generation and query-
+    parameter generation on the SAME distribution tables (the dsdgen/
+    dsqgen .dst-file sharing analog; streamgen.py reads the json
+    directly)."""
+    import json
+    with open(_DISTS_JSON) as f:
+        dists = json.load(f)
+
+    def esc(s: str) -> str:
+        return s.replace("\\", "\\\\").replace('"', '\\"')
+
+    lines = [
+        "// GENERATED from dists.json by ndstpu.check.render_dists_header",
+        "// -- do not edit; edit dists.json.",
+        "#pragma once",
+        "struct DistEntry { const char* v; int w; };",
+        "struct DistTable { const DistEntry* e; int n; int total; };",
+    ]
+    for name, d in dists.items():
+        if name.startswith("_"):
+            continue
+        vals, weights = d["values"], d["weights"]
+        if len(vals) != len(weights):
+            raise RuntimeError(f"dists.json {name}: {len(vals)} values "
+                               f"vs {len(weights)} weights")
+        entries = ", ".join(f'{{"{esc(v)}", {w}}}'
+                            for v, w in zip(vals, weights))
+        lines.append(f"static const DistEntry kDist_{name}_e[] = "
+                     f"{{{entries}}};")
+        lines.append(f"static const DistTable kDist_{name} = "
+                     f"{{kDist_{name}_e, {len(vals)}, {sum(weights)}}};")
+    _DISTS_HEADER.parent.mkdir(parents=True, exist_ok=True)
+    _DISTS_HEADER.write_text("\n".join(lines) + "\n")
+    return _DISTS_HEADER
 
 
 def check_build(rebuild: bool = False) -> Path:
@@ -38,10 +78,12 @@ def check_build(rebuild: bool = False) -> Path:
     check.py:47-66)."""
     check_version()
     if _NDSGEN_BIN.exists() and not rebuild:
-        if _NDSGEN_BIN.stat().st_mtime >= _NDSGEN_SRC.stat().st_mtime:
+        if _NDSGEN_BIN.stat().st_mtime >= max(
+                _NDSGEN_SRC.stat().st_mtime, _DISTS_JSON.stat().st_mtime):
             return _NDSGEN_BIN
-    _NDSGEN_BIN.parent.mkdir(parents=True, exist_ok=True)
-    cmd = ["g++", "-O2", "-o", str(_NDSGEN_BIN), str(_NDSGEN_SRC)]
+    render_dists_header()
+    cmd = ["g++", "-O2", f"-I{_DISTS_HEADER.parent}",
+           "-o", str(_NDSGEN_BIN), str(_NDSGEN_SRC)]
     print("building native generator:", " ".join(cmd))
     subprocess.run(cmd, check=True)
     return _NDSGEN_BIN

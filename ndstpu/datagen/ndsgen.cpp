@@ -165,23 +165,6 @@ struct Writer {
 // Word pools
 // ---------------------------------------------------------------------------
 
-static const char* kCities[] = {"Midway", "Fairview", "Oakland", "Springdale",
-    "Salem", "Georgetown", "Ashland", "Riverside", "Greenville", "Franklin",
-    "Clinton", "Marion", "Bethel", "Oakdale", "Union", "Wilson", "Glendale",
-    "Centerville", "Hopewell", "Lakeview", "Pleasant Hill", "Mount Olive",
-    "Shiloh", "Five Points", "Oak Grove", "Newport", "Woodville", "Concord",
-    "Antioch", "Friendship"};
-static const char* kCounties[] = {"Williamson County", "Walker County",
-    "Ziebach County", "Daviess County", "Barrow County", "Franklin Parish",
-    "Luce County", "Richland County", "Furnas County", "Maverick County",
-    "Pennington County", "Bronx County", "Jackson County", "Mesa County",
-    "Dauphin County", "Levy County", "Coal County", "Mobile County",
-    "San Miguel County", "Perry County"};
-static const char* kStates[] = {"AL", "AK", "AZ", "AR", "CA", "CO", "CT",
-    "DE", "FL", "GA", "HI", "ID", "IL", "IN", "IA", "KS", "KY", "LA", "ME",
-    "MD", "MA", "MI", "MN", "MS", "MO", "MT", "NE", "NV", "NH", "NJ", "NM",
-    "NY", "NC", "ND", "OH", "OK", "OR", "PA", "RI", "SC", "SD", "TN", "TX",
-    "UT", "VT", "VA", "WA", "WV", "WI", "WY"};
 static const char* kStreetNames[] = {"Main", "Oak", "Park", "First", "Elm",
     "Second", "Washington", "Maple", "Cedar", "Pine", "Lake", "Hill", "Walnut",
     "Spring", "North", "Ridge", "Church", "Willow", "Mill", "Sunset", "Railroad",
@@ -208,21 +191,7 @@ static const char* kLastNames[] = {"Smith", "Johnson", "Williams", "Brown",
     "Sanchez", "Clark", "Ramirez", "Lewis", "Robinson", "Walker", "Young",
     "Allen", "King", "Wright", "Scott", "Torres", "Nguyen", "Hill", "Flores"};
 static const char* kSalutations[] = {"Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir"};
-static const char* kEducation[] = {"Primary", "Secondary", "College",
-    "2 yr Degree", "4 yr Degree", "Advanced Degree", "Unknown"};
-static const char* kMarital[] = {"M", "S", "D", "W", "U"};
-static const char* kGender[] = {"M", "F"};
 static const char* kCredit[] = {"Low Risk", "Good", "High Risk", "Unknown"};
-static const char* kBuyPotential[] = {"0-500", "501-1000", "1001-5000",
-    "5001-10000", ">10000", "Unknown"};
-static const char* kCategories[] = {"Women", "Men", "Children", "Shoes",
-    "Music", "Jewelry", "Home", "Sports", "Books", "Electronics"};
-static const char* kClasses[] = {"accent", "bathroom", "bedding", "classical",
-    "country", "dresses", "fragrances", "infants", "maternity", "pants",
-    "pop", "rock", "shirts", "swimwear", "athletic", "casual", "formal",
-    "mens watch", "womens watch", "computers", "cameras", "televisions",
-    "football", "baseball", "basketball", "fiction", "history", "romance",
-    "self-help", "travel"};
 static const char* kColors[] = {"red", "blue", "green", "yellow", "purple",
     "orange", "black", "white", "pink", "brown", "gray", "cyan", "magenta",
     "ivory", "khaki", "lavender", "maroon", "navy", "olive", "salmon", "tan",
@@ -239,10 +208,6 @@ static const char* kHours[] = {"8AM-4PM", "8AM-8AM", "8AM-12AM"};
 static const char* kShipTypes[] = {"EXPRESS", "NEXT DAY", "OVERNIGHT",
     "REGULAR", "TWO DAY", "LIBRARY"};
 static const char* kShipCodes[] = {"AIR", "SURFACE", "SEA"};
-static const char* kCarriers[] = {"UPS", "FEDEX", "AIRBORNE", "USPS", "DHL",
-    "TBS", "ZHOU", "GREAT EASTERN", "DIAMOND", "RUPEKSA", "ORIENTAL", "BOXBUNDLES",
-    "ALLIANCE", "GERMA", "HARMSTORF", "PRIVATECARRIER", "MSC", "LATVIAN", "ZOUROS",
-    "GLOBAL"};
 static const char* kShifts[] = {"first", "second", "third"};
 static const char* kWordPool[] = {"results", "important", "whole", "right",
     "general", "great", "special", "large", "social", "economic", "national",
@@ -258,24 +223,29 @@ static const char* pick(Rng& r, const char* const (&pool)[N]) {
   return pool[r.next() % N];
 }
 
-// Weighted county pick — the analog of dsdgen's fips_county
-// distribution table (reference nds/tpcds-gen/patches/templates.patch
-// `distmember(fips_county, ...)`): a few counties dominate, so county
-// predicates (query16/34/...) see realistic selectivity instead of a
-// uniform 1/20.  Weights mirror ndstpu/queries/streamgen.py
-// _DISTRIBUTIONS["fips_county"] — keep the two in sync.
-static const int kCountyWeights[] = {100, 80, 60, 45, 35, 28, 22, 18, 14,
-                                     11, 9, 7, 6, 5, 4, 3, 3, 2, 2, 1};
-static const char* pick_county(Rng& r) {
-  static int total = 0;
-  if (!total)
-    for (int w : kCountyWeights) total += w;
-  int64_t x = r.range(0, total - 1);
-  for (size_t i = 0; i < sizeof(kCountyWeights) / sizeof(int); i++) {
-    x -= kCountyWeights[i];
-    if (x < 0) return kCounties[i];
+// Shared weighted distribution tables — generated from dists.json at
+// build time (ndstpu.check.render_dists_header).  The SAME tables feed
+// dsqgen-style template-parameter draws in streamgen.py, the analog of
+// dsdgen and dsqgen reading the same .dst files (reference
+// nds/tpcds-gen/patches/templates.patch `distmember(fips_county,...)`):
+// predicates rendered into queries land on value domains the generated
+// data actually has, with realistic non-uniform selectivity.
+#include "dists_gen.h"
+
+static int dpick_idx(Rng& r, const DistTable& t) {
+  int64_t x = r.range(0, t.total - 1);
+  for (int i = 0; i < t.n; i++) {
+    x -= t.e[i].w;
+    if (x < 0) return i;
   }
-  return kCounties[0];
+  return 0;
+}
+static const char* dpick(Rng& r, const DistTable& t) {
+  return t.e[dpick_idx(r, t)].v;
+}
+// gmt-offset tables carry string values ("-5"); columns store ints
+static int64_t dpick_int(Rng& r, const DistTable& t) {
+  return atoll(dpick(r, t));
 }
 
 static std::string sentence(Rng& r, int nwords) {
@@ -574,16 +544,15 @@ static void gen_customer_address(Writer& w, int64_t b, int64_t e) {
       w.fstr(suite);
     } else
       w.fnull();
-    w.fstr(pick(r, kCities));
-    w.fstr(pick_county(r));
-    const char* st = pick(r, kStates);
-    w.fstr(st);
+    w.fstr(dpick(r, kDist_cities));
+    w.fstr(dpick(r, kDist_fips_county));
+    w.fstr(dpick(r, kDist_states));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
     w.fstr(zip);
     w.fstr(kCountries[0]);
-    // gmt offset -5..-10 whole hours
-    w.fmoney(-100 * r.range(5, 10));
+    // gmt offset, weighted toward eastern/central like the population
+    w.fmoney(100 * dpick_int(r, kDist_gmt_offset));
     w.fstr(pick(r, kLocationTypes));
     w.endrow();
   }
@@ -594,18 +563,18 @@ static void gen_customer_demographics(Writer& w, int64_t b, int64_t e) {
   // x purchase_estimate x credit x dep x dep_employed x dep_college
   for (int64_t i = b; i < e; i++) {
     int64_t sk = i + 1, v = i;
-    int g = v % 2; v /= 2;
-    int m = v % 5; v /= 5;
-    int ed = v % 7; v /= 7;
+    int g = v % kDist_gender.n; v /= kDist_gender.n;
+    int m = v % kDist_marital_status.n; v /= kDist_marital_status.n;
+    int ed = v % kDist_education.n; v /= kDist_education.n;
     int pe = v % 20; v /= 20;
     int cr = v % 4; v /= 4;
     int dep = v % 7; v /= 7;
     int depe = v % 7; v /= 7;
     int depc = v % 7;
     w.fint(sk);
-    w.fstr(kGender[g]);
-    w.fstr(kMarital[m]);
-    w.fstr(kEducation[ed]);
+    w.fstr(kDist_gender.e[g].v);
+    w.fstr(kDist_marital_status.e[m].v);
+    w.fstr(kDist_education.e[ed].v);
     w.fint(500 * (pe + 1));
     w.fstr(kCredit[cr]);
     w.fint(dep);
@@ -710,9 +679,9 @@ static void gen_warehouse(Writer& w, int64_t b, int64_t e) {
     char suite[16];
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
-    w.fstr(pick(r, kCities));
-    w.fstr(pick_county(r));
-    w.fstr(pick(r, kStates));
+    w.fstr(dpick(r, kDist_cities));
+    w.fstr(dpick(r, kDist_fips_county));
+    w.fstr(dpick(r, kDist_states));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
     w.fstr(zip);
@@ -730,7 +699,7 @@ static void gen_ship_mode(Writer& w, int64_t b, int64_t e) {
     w.fstr(bkey(sk));
     w.fstr(kShipTypes[i % 6]);
     w.fstr(kShipCodes[(i / 6) % 3]);
-    w.fstr(kCarriers[i % 20]);
+    w.fstr(kDist_carriers.e[i % kDist_carriers.n].v);
     char contract[24];
     snprintf(contract, sizeof contract, "%" PRId64, r.range(1000000, 9999999));
     w.fstr(contract);
@@ -739,22 +708,11 @@ static void gen_ship_mode(Writer& w, int64_t b, int64_t e) {
 }
 
 static void gen_reason(Writer& w, int64_t b, int64_t e) {
-  static const char* kReasons[] = {"Package was damaged", "Stopped working",
-      "Did not get it on time", "Not the product that was ordred", "Parts missing",
-      "Does not work with a product that I have", "Gift exchange",
-      "Did not like the color", "Did not like the model", "Did not like the make",
-      "Did not like the warranty", "No service location in my area",
-      "Found a better price in a store", "Found a better extended warranty",
-      "reason 15", "reason 16", "reason 17", "reason 18", "reason 19",
-      "reason 20", "reason 21", "reason 22", "reason 23", "reason 24",
-      "reason 25", "reason 26", "reason 27", "reason 28", "reason 29",
-      "reason 30", "reason 31", "reason 32", "reason 33", "reason 34",
-      "reason 35"};
   for (int64_t i = b; i < e; i++) {
     int64_t sk = i + 1;
     w.fint(sk);
     w.fstr(bkey(sk));
-    w.fstr(kReasons[i % 35]);
+    w.fstr(kDist_reasons.e[i % kDist_reasons.n].v);
     w.endrow();
   }
 }
@@ -784,20 +742,23 @@ static void gen_item(Writer& w, int64_t b, int64_t e) {
     int64_t price = r.cents(100, 10000);
     w.fmoney(price);
     w.fmoney((price * r.range(30, 90)) / 100);
-    int cat = (int)(i % 10);
-    int cls = (int)(r.next() % 30);
+    // weighted category/class: hot categories get more items, so
+    // Zipf-hot item keys skew category aggregates realistically (the
+    // dist indices also feed the brand-id encoding below)
+    int cat = dpick_idx(r, kDist_categories);
+    int cls = dpick_idx(r, kDist_classes);
     int brand = (int)(r.range(1, 10));
     int64_t brand_id = (cat + 1) * 1000000 + (cls + 1) * 1000 + brand;
     w.fint(brand_id);
     {
       char bn[40];
-      snprintf(bn, sizeof bn, "%s #%d", kClasses[cls], brand);
+      snprintf(bn, sizeof bn, "%s #%d", kDist_classes.e[cls].v, brand);
       w.fstr(bn);  // i_brand
     }
     w.fint(cls + 1);
-    w.fstr(kClasses[cls]);
+    w.fstr(kDist_classes.e[cls].v);
     w.fint(cat + 1);
-    w.fstr(kCategories[cat]);
+    w.fstr(kDist_categories.e[cat].v);
     int64_t manu = r.range(1, 1000);
     w.fint(manu);
     {
@@ -807,7 +768,7 @@ static void gen_item(Writer& w, int64_t b, int64_t e) {
     }
     w.fstr(pick(r, kSizes));
     w.fstr(sentence(r, 2));  // formulation
-    w.fstr(pick(r, kColors));
+    w.fstr(dpick(r, kDist_colors));
     w.fstr(pick(r, kUnits));
     w.fstr(kContainers[0]);
     w.fint(r.range(1, 100));
@@ -853,14 +814,18 @@ static void gen_store(Writer& w, int64_t b, int64_t e) {
     char suite[16];
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
-    w.fstr(pick(r, kCities));
-    w.fstr(pick_county(r));
-    w.fstr(kStates[i % 12]);  // concentrate stores in few states like TPC
+    // stores draw from the small CONDITIONED pools (store_cities /
+    // store_states / store_gmt): with only 12 stores at SF1, template
+    // parameters predicating on s_city/s_state must share the exact
+    // domain stores are assigned from or they match zero rows
+    w.fstr(dpick(r, kDist_store_cities));
+    w.fstr(dpick(r, kDist_fips_county));
+    w.fstr(dpick(r, kDist_store_states));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
     w.fstr(zip);
     w.fstr(kCountries[0]);
-    w.fmoney(-100 * r.range(5, 10));
+    w.fmoney(100 * dpick_int(r, kDist_store_gmt));
     w.fmoney(r.range(0, 11));  // tax percentage 0.00-0.11
     w.endrow();
   }
@@ -902,9 +867,9 @@ static void gen_call_center(Writer& w, int64_t b, int64_t e) {
     char suite[16];
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
-    w.fstr(pick(r, kCities));
-    w.fstr(pick_county(r));
-    w.fstr(pick(r, kStates));
+    w.fstr(dpick(r, kDist_cities));
+    w.fstr(dpick(r, kDist_fips_county));
+    w.fstr(dpick(r, kDist_states));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
     w.fstr(zip);
@@ -981,9 +946,9 @@ static void gen_web_site(Writer& w, int64_t b, int64_t e) {
     char suite[16];
     snprintf(suite, sizeof suite, "Suite %" PRId64, r.range(0, 99));
     w.fstr(suite);
-    w.fstr(pick(r, kCities));
-    w.fstr(pick_county(r));
-    w.fstr(pick(r, kStates));
+    w.fstr(dpick(r, kDist_cities));
+    w.fstr(dpick(r, kDist_fips_county));
+    w.fstr(dpick(r, kDist_states));
     char zip[8];
     snprintf(zip, sizeof zip, "%05" PRId64, r.range(601, 99950));
     w.fstr(zip);
@@ -998,12 +963,12 @@ static void gen_household_demographics(Writer& w, int64_t b, int64_t e) {
   for (int64_t i = b; i < e; i++) {
     int64_t sk = i + 1, v = i;
     int ib = v % 20; v /= 20;
-    int bp = v % 6; v /= 6;
+    int bp = v % kDist_buy_potential.n; v /= kDist_buy_potential.n;
     int dep = v % 10; v /= 10;
     int veh = v % 6;
     w.fint(sk);
     w.fint(ib + 1);
-    w.fstr(kBuyPotential[bp]);
+    w.fstr(kDist_buy_potential.e[bp].v);
     w.fint(dep);
     w.fint(veh - 1 + 1);
     w.endrow();

@@ -56,23 +56,24 @@ _DEFINE_RE = re.compile(
 # Named weighted value distributions — the dsqgen distribution-table
 # analog (the TPC toolkit ships these as .dst files; dsqgen's
 # `distmember(fips_county, [N], 2)` picks weighted county names).
-# fips_county weights MUST mirror ndsgen.cpp kCountyWeights — the
-# generator draws county columns from the same distribution so that
-# substituted predicates see realistic (non-uniform) selectivity.
-_DISTRIBUTIONS: Dict[str, List[Tuple[str, int]]] = {
-    "fips_county": [
-        ("Williamson County", 100), ("Walker County", 80),
-        ("Ziebach County", 60), ("Daviess County", 45),
-        ("Barrow County", 35), ("Franklin Parish", 28),
-        ("Luce County", 22), ("Richland County", 18),
-        ("Furnas County", 14), ("Maverick County", 11),
-        ("Pennington County", 9), ("Bronx County", 7),
-        ("Jackson County", 6), ("Mesa County", 5),
-        ("Dauphin County", 4), ("Levy County", 3),
-        ("Coal County", 3), ("Mobile County", 2),
-        ("San Miguel County", 2), ("Perry County", 1),
-    ],
-}
+# Loaded from ndstpu/datagen/dists.json, the SAME file the native
+# generator compiles its column-value tables from (ndstpu.check
+# renders it into dists_gen.h at build time): data generation and
+# query-parameter generation share one source of truth, so rendered
+# predicates always land on domains the data actually has, with the
+# same non-uniform selectivity the generator produced.
+
+
+def _load_distributions() -> Dict[str, List[Tuple[str, int]]]:
+    import json
+    path = Path(__file__).resolve().parent.parent / "datagen" / "dists.json"
+    with open(path) as f:
+        raw = json.load(f)
+    return {name: list(zip(d["values"], d["weights"]))
+            for name, d in raw.items() if not name.startswith("_")}
+
+
+_DISTRIBUTIONS = _load_distributions()
 
 
 def _dist_pick(rng: random.Random, dname: str, k: int = 1,
@@ -121,27 +122,47 @@ def _stable_seed(rngseed: str, stream: int, template: str) -> int:
     return int.from_bytes(h[:8], "big")
 
 
-def render_template(template_path: str, rngseed: str, stream: int) -> str:
-    text = Path(template_path).read_text()
-    params, body = _parse_template(text)
-    rng = random.Random(_stable_seed(rngseed, stream,
-                                     Path(template_path).name))
+def _draw_params(params: Dict[str, tuple], tpl_name: str, rngseed: str,
+                 stream: int) -> Dict[str, object]:
+    """One rng pass over the parsed defines — {name: value} for scalar
+    params, {name: [values]} for distlist params.  Deterministic in
+    (rngseed, stream, template name)."""
+    rng = random.Random(_stable_seed(rngseed, stream, tpl_name))
+    out: Dict[str, object] = {}
     for name, (kind, vals) in params.items():
         if kind == "uniform":
-            v = str(rng.randint(int(vals[0]), int(vals[1])))
+            out[name] = str(rng.randint(int(vals[0]), int(vals[1])))
         elif kind == "dist":
-            v = _dist_pick(rng, vals[0])[0]
+            out[name] = _dist_pick(rng, vals[0])[0]
         elif kind in ("distlist", "distlistu"):
-            picks = _dist_pick(rng, vals[0], int(vals[1]),
-                               distinct=(kind == "distlistu"))
-            for i, p in enumerate(picks, 1):
-                body = body.replace(f"[{name}.{i}]", p)
-            continue
+            out[name] = _dist_pick(rng, vals[0], int(vals[1]),
+                                   distinct=(kind == "distlistu"))
         else:  # choice
             v = rng.choice(vals).strip()
             if v.startswith("'") and v.endswith("'"):
                 v = v[1:-1]
-        body = body.replace(f"[{name}]", v)
+            out[name] = v
+    return out
+
+
+def render_params(template_path: str, rngseed: str,
+                  stream: int) -> Dict[str, object]:
+    """The parameter draws for one (template, stream) pair; the audit
+    tooling uses this to check every drawn value against the generated
+    data domain (scripts/param_audit.py)."""
+    params, _body = _parse_template(Path(template_path).read_text())
+    return _draw_params(params, Path(template_path).name, rngseed, stream)
+
+
+def render_template(template_path: str, rngseed: str, stream: int) -> str:
+    params, body = _parse_template(Path(template_path).read_text())
+    drawn = _draw_params(params, Path(template_path).name, rngseed, stream)
+    for name, v in drawn.items():
+        if isinstance(v, list):
+            for i, p in enumerate(v, 1):
+                body = body.replace(f"[{name}.{i}]", p)
+        else:
+            body = body.replace(f"[{name}]", v)
     leftover = re.findall(r"\[([A-Z][A-Z0-9_.]*)\]", body)
     if leftover:
         raise ValueError(

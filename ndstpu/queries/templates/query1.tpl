@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define STATE = choice('AL','GA','CA','CO','FL','ID','IL','IN','IA','KS')
+--@ define STATE = dist(store_states)
 with customer_total_return as
 (select sr_customer_sk as ctr_customer_sk,
         sr_store_sk as ctr_store_sk,

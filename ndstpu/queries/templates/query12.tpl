@@ -1,7 +1,5 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define CAT1 = choice('Sports', 'Books', 'Home')
---@ define CAT2 = choice('Men', 'Women', 'Shoes')
---@ define CAT3 = choice('Jewelry', 'Music', 'Electronics')
+--@ define CAT = distlistu(categories, 3)
 --@ define SDATE = choice('1998-02-22', '1999-02-22', '2000-02-22', '2001-02-22')
 select i_item_id, i_item_desc, i_category, i_class, i_current_price,
        sum(ws_ext_sales_price) as itemrevenue,
@@ -9,7 +7,7 @@ select i_item_id, i_item_desc, i_category, i_class, i_current_price,
            over (partition by i_class) as revenueratio
 from web_sales, item, date_dim
 where ws_item_sk = i_item_sk
-  and i_category in ('[CAT1]', '[CAT2]', '[CAT3]')
+  and i_category in ('[CAT.1]', '[CAT.2]', '[CAT.3]')
   and ws_sold_date_sk = d_date_sk
   and d_date between cast('[SDATE]' as date)
                  and (cast('[SDATE]' as date) + interval 30 days)

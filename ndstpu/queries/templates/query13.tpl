@@ -1,13 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define MS1 = choice('M', 'S', 'W')
---@ define MS2 = choice('D', 'U', 'S')
---@ define MS3 = choice('M', 'W', 'D')
---@ define ES1 = choice('Primary', 'Secondary', 'College')
---@ define ES2 = choice('2 yr Degree', '4 yr Degree', 'Advanced Degree')
---@ define ES3 = choice('Unknown', 'College', 'Secondary')
---@ define ST1 = choice('AL', 'GA', 'CA')
---@ define ST2 = choice('CO', 'FL', 'ID')
---@ define ST3 = choice('IL', 'IN', 'IA')
+--@ define MS = distlistu(marital_status, 3)
+--@ define ES = distlistu(education, 3)
+--@ define ST = distlistu(states, 3)
 select avg(ss_quantity) aq,
        avg(ss_ext_sales_price) aesp,
        avg(ss_ext_wholesale_cost) aewc,
@@ -18,31 +12,31 @@ where s_store_sk = ss_store_sk
   and ss_sold_date_sk = d_date_sk and d_year = [YEAR]
   and ((ss_hdemo_sk = hd_demo_sk
         and cd_demo_sk = ss_cdemo_sk
-        and cd_marital_status = '[MS1]'
-        and cd_education_status = '[ES1]'
+        and cd_marital_status = '[MS.1]'
+        and cd_education_status = '[ES.1]'
         and ss_sales_price between 100.00 and 150.00
         and hd_dep_count = 3)
     or (ss_hdemo_sk = hd_demo_sk
         and cd_demo_sk = ss_cdemo_sk
-        and cd_marital_status = '[MS2]'
-        and cd_education_status = '[ES2]'
+        and cd_marital_status = '[MS.2]'
+        and cd_education_status = '[ES.2]'
         and ss_sales_price between 50.00 and 100.00
         and hd_dep_count = 1)
     or (ss_hdemo_sk = hd_demo_sk
         and cd_demo_sk = ss_cdemo_sk
-        and cd_marital_status = '[MS3]'
-        and cd_education_status = '[ES3]'
+        and cd_marital_status = '[MS.3]'
+        and cd_education_status = '[ES.3]'
         and ss_sales_price between 150.00 and 200.00
         and hd_dep_count = 1))
   and ((ss_addr_sk = ca_address_sk
         and ca_country = 'United States'
-        and ca_state in ('[ST1]', '[ST2]', '[ST3]')
+        and ca_state in ('[ST.1]', '[ST.2]', '[ST.3]')
         and ss_net_profit between 100 and 200)
     or (ss_addr_sk = ca_address_sk
         and ca_country = 'United States'
-        and ca_state in ('[ST1]', '[ST2]', '[ST3]')
+        and ca_state in ('[ST.1]', '[ST.2]', '[ST.3]')
         and ss_net_profit between 150 and 300)
     or (ss_addr_sk = ca_address_sk
         and ca_country = 'United States'
-        and ca_state in ('[ST1]', '[ST2]', '[ST3]')
+        and ca_state in ('[ST.1]', '[ST.2]', '[ST.3]')
         and ss_net_profit between 50 and 250))

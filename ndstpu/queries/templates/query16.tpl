@@ -1,6 +1,6 @@
 --@ define MONTH = uniform(2, 5)
 --@ define YEAR = uniform(1999, 2002)
---@ define STATE = choice('GA','TX','CA','NY','IL','OH','PA','NC')
+--@ define STATE = dist(states)
 --@ define COUNTY = distlist(fips_county, 5)
 select
    count(distinct cs_order_number) as order_count

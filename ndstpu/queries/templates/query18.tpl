@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define ES = choice('Primary', 'Secondary', 'College', '2 yr Degree', '4 yr Degree', 'Advanced Degree', 'Unknown')
+--@ define ES = dist(education)
 select i_item_id, ca_country, ca_state, ca_county,
        avg(cast(cs_quantity as decimal(12, 2))) agg1,
        avg(cast(cs_list_price as decimal(12, 2))) agg2,

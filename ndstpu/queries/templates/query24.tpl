@@ -1,6 +1,5 @@
 --@ define MARKET = uniform(5, 10)
---@ define COLOR1 = choice('peach','saddle','rosy','powder','snow','sandy','salmon','navajo')
---@ define COLOR2 = choice('hot','pale','dim','cream','misty','papaya','burnished','chiffon')
+--@ define COLOR = distlistu(colors, 2)
 with ssales as
 (select c_last_name
       ,c_first_name
@@ -43,7 +42,7 @@ select c_last_name
       ,s_store_name
       ,sum(netpaid) paid
 from ssales
-where i_color = '[COLOR1]'
+where i_color = '[COLOR.1]'
 group by c_last_name
         ,c_first_name
         ,s_store_name
@@ -95,7 +94,7 @@ select c_last_name
       ,s_store_name
       ,sum(netpaid) paid
 from ssales
-where i_color = '[COLOR2]'
+where i_color = '[COLOR.2]'
 group by c_last_name
         ,c_first_name
         ,s_store_name

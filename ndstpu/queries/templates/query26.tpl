@@ -1,7 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define GEN = choice('M', 'F')
---@ define MS = choice('M', 'S', 'D', 'W', 'U')
---@ define ES = choice('Primary', 'Secondary', 'College', '4 yr Degree')
+--@ define GEN = dist(gender)
+--@ define MS = dist(marital_status)
+--@ define ES = dist(education)
 select i_item_id,
        avg(cs_quantity) agg1,
        avg(cs_list_price) agg2,

@@ -1,6 +1,6 @@
---@ define GEN = choice('M', 'F')
---@ define MS = choice('M', 'S', 'D', 'W', 'U')
---@ define ES = choice('Primary', 'Secondary', 'College', '2 yr Degree', '4 yr Degree', 'Advanced Degree', 'Unknown')
+--@ define GEN = dist(gender)
+--@ define MS = dist(marital_status)
+--@ define ES = dist(education)
 --@ define YEAR = uniform(1998, 2002)
 select i_item_id, s_state, grouping(s_state) g_state,
        avg(ss_quantity) agg1,

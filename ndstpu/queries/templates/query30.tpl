@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1999, 2002)
---@ define STATE = choice('GA', 'IL', 'IN')
+--@ define STATE = dist(states)
 with customer_total_return as (
     select wr_returning_customer_sk as ctr_customer_sk,
            ca_state as ctr_state,

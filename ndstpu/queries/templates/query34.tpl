@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1998, 2000)
---@ define BP = choice('>10000', '1001-5000')
+--@ define BP = dist(buy_potential)
 --@ define COUNTY = distlistu(fips_county, 8)
 select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
        ss_ticket_number, cnt

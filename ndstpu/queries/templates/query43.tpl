@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define GMT = choice(-5, -6, -7, -8)
+--@ define GMT = dist(store_gmt)
 select s_store_name, s_store_id,
        sum(case when d_day_name = 'Sunday' then ss_sales_price else null end) sun_sales,
        sum(case when d_day_name = 'Monday' then ss_sales_price else null end) mon_sales,

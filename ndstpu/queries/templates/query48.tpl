@@ -1,6 +1,6 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define MS = choice('M', 'S', 'D', 'W', 'U')
---@ define ES = choice('Primary', 'Secondary', 'College', '4 yr Degree')
+--@ define MS = dist(marital_status)
+--@ define ES = dist(education)
 select sum(ss_quantity) sq
 from store_sales, store, customer_demographics, customer_address, date_dim
 where s_store_sk = ss_store_sk

@@ -1,7 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
 --@ define MONTH = uniform(1, 7)
---@ define CATEGORY = choice('Women','Music','Men','Jewelry','Shoes','Sports','Books','Home','Electronics','Children')
---@ define CLASS = choice('maternity','pop','pants','birdal','athletic','baseball','science','bathroom','portable','toddlers')
+--@ define CATEGORY = dist(categories)
+--@ define CLASS = dist(classes)
 with my_customers as (
  select distinct c_customer_sk
         , c_current_addr_sk

@@ -1,7 +1,7 @@
---@ define CATEGORY = choice('Music', 'Children', 'Jewelry')
+--@ define CATEGORY = dist(categories)
 --@ define YEAR = uniform(1998, 2002)
 --@ define MONTH = uniform(8, 10)
---@ define GMT = choice(-6, -5)
+--@ define GMT = dist(gmt_offset)
 with ss as (
     select i_item_id, sum(ss_ext_sales_price) total_sales
     from store_sales, date_dim, customer_address, item

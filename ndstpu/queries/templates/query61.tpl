@@ -1,7 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
 --@ define MONTH = uniform(11, 12)
---@ define GMT = choice(-6, -5, -7)
---@ define CATEGORY = choice('Jewelry', 'Books', 'Home')
+--@ define GMT = dist(store_gmt)
+--@ define CATEGORY = dist(categories)
 select promotions, total,
        cast(promotions as decimal(15, 4)) /
        cast(total as decimal(15, 4)) * 100

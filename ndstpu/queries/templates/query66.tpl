@@ -1,7 +1,6 @@
 --@ define YEAR = uniform(1998, 2002)
 --@ define TIME = uniform(1000, 50000)
---@ define SM1 = choice('DHL', 'USPS', 'UPS')
---@ define SM2 = choice('BARIAN', 'LATVIAN', 'AIRBORNE')
+--@ define SM = distlistu(carriers, 2)
 select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
        w_country, ship_carriers, year,
        sum(jan_sales) as jan_sales,
@@ -32,7 +31,7 @@ select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,
        sum(dec_net) as dec_net
 from (select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
              w_state, w_country,
-             '[SM1]' || ',' || '[SM2]' as ship_carriers,
+             '[SM.1]' || ',' || '[SM.2]' as ship_carriers,
              d_year as year,
              sum(case when d_moy = 1 then ws_ext_sales_price * ws_quantity
                       else 0 end) as jan_sales,
@@ -89,13 +88,13 @@ from (select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
         and ws_ship_mode_sk = sm_ship_mode_sk
         and d_year = [YEAR]
         and t_time between [TIME] and [TIME] + 28800
-        and sm_carrier in ('[SM1]', '[SM2]')
+        and sm_carrier in ('[SM.1]', '[SM.2]')
       group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
                w_state, w_country, d_year
       union all
       select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
              w_state, w_country,
-             '[SM1]' || ',' || '[SM2]' as ship_carriers,
+             '[SM.1]' || ',' || '[SM.2]' as ship_carriers,
              d_year as year,
              sum(case when d_moy = 1 then cs_sales_price * cs_quantity
                       else 0 end) as jan_sales,
@@ -152,7 +151,7 @@ from (select w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
         and cs_ship_mode_sk = sm_ship_mode_sk
         and d_year = [YEAR]
         and t_time between [TIME] and [TIME] + 28800
-        and sm_carrier in ('[SM1]', '[SM2]')
+        and sm_carrier in ('[SM.1]', '[SM.2]')
       group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
                w_state, w_country, d_year) x
 group by w_warehouse_name, w_warehouse_sq_ft, w_city, w_county, w_state,

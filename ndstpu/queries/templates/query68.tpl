@@ -1,8 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
 --@ define DEP = uniform(0, 9)
 --@ define VEH = uniform(0, 4)
---@ define CITY1 = choice('Midway', 'Fairview', 'Oakland')
---@ define CITY2 = choice('Salem', 'Georgetown', 'Ashland')
+--@ define CITY = distlistu(store_cities, 2)
 select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
        extended_price, extended_tax, list_price
 from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
@@ -19,7 +18,7 @@ from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
         and (household_demographics.hd_dep_count = [DEP]
              or household_demographics.hd_vehicle_count = [VEH])
         and date_dim.d_year in ([YEAR], [YEAR] + 1, [YEAR] + 2)
-        and store.s_city in ('[CITY1]', '[CITY2]')
+        and store.s_city in ('[CITY.1]', '[CITY.2]')
       group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
      customer, customer_address current_addr
 where ss_customer_sk = c_customer_sk

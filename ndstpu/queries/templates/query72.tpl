@@ -1,6 +1,6 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define BP = choice('>10000','1001-5000','501-1000','0-500','5001-10000','Unknown')
---@ define MS = choice('M','S','D','W','U')
+--@ define BP = dist(buy_potential)
+--@ define MS = dist(marital_status)
 select  i_item_desc
       ,w_warehouse_name
       ,d1.d_week_seq

@@ -1,6 +1,5 @@
 --@ define YEAR = uniform(1998, 2002)
---@ define BP1 = choice('1001-5000', '501-1000')
---@ define BP2 = choice('0-500', '5001-10000')
+--@ define BP = distlistu(buy_potential, 2)
 select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
        ss_ticket_number, cnt
 from (select ss_ticket_number, ss_customer_sk, count(*) cnt
@@ -9,8 +8,8 @@ from (select ss_ticket_number, ss_customer_sk, count(*) cnt
         and store_sales.ss_store_sk = store.s_store_sk
         and store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
         and date_dim.d_dom between 1 and 2
-        and (household_demographics.hd_buy_potential = '[BP1]'
-             or household_demographics.hd_buy_potential = '[BP2]')
+        and (household_demographics.hd_buy_potential = '[BP.1]'
+             or household_demographics.hd_buy_potential = '[BP.2]')
         and household_demographics.hd_vehicle_count > 0
         and date_dim.d_year in ([YEAR], [YEAR] + 1, [YEAR] + 2)
       group by ss_ticket_number, ss_customer_sk) dj, customer

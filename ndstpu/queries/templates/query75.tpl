@@ -1,5 +1,5 @@
 --@ define YEAR = uniform(1999, 2002)
---@ define CATEGORY = choice('Sports','Books','Home','Electronics','Shoes','Men','Women','Children','Music','Jewelry')
+--@ define CATEGORY = dist(categories)
 WITH all_sales AS (
  SELECT d_year
        ,i_brand_id

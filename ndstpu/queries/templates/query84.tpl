@@ -1,5 +1,5 @@
 --@ define INCOME = uniform(0, 70000)
---@ define CITY = choice('Edgewood', 'Fairview', 'Midway', 'Oakdale')
+--@ define CITY = dist(cities)
 select c_customer_id as customer_id,
        coalesce(c_last_name, '') || ', ' || coalesce(c_first_name, '')
            as customername

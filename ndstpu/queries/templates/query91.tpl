@@ -1,7 +1,7 @@
 --@ define YEAR = uniform(1998, 2002)
 --@ define MONTH = uniform(11, 12)
---@ define GMT = choice(-6, -7)
---@ define BP = choice('>10000', 'Unknown')
+--@ define GMT = dist(gmt_offset)
+--@ define BP = dist(buy_potential)
 select cc_call_center_id call_center, cc_name call_center_name,
        cc_manager manager, sum(cr_net_loss) returns_loss
 from call_center, catalog_returns, date_dim, customer, customer_address,

@@ -1,4 +1,4 @@
---@ define REASON = choice('reason 28', 'reason 58', 'reason 19')
+--@ define REASON = dist(reasons)
 select ss_customer_sk, sum(act_sales) sumsales
 from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
              case when sr_return_quantity is not null

@@ -1,6 +1,6 @@
 --@ define MONTH = uniform(2, 5)
 --@ define YEAR = uniform(1999, 2002)
---@ define STATE = choice('GA','TX','CA','NY','IL','OH','PA','NC')
+--@ define STATE = dist(states)
 select
    count(distinct ws_order_number) as order_count
   ,sum(ws_ext_ship_cost) as total_shipping_cost

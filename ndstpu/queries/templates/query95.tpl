@@ -1,6 +1,6 @@
 --@ define MONTH = uniform(2, 5)
 --@ define YEAR = uniform(1999, 2002)
---@ define STATE = choice('GA','TX','CA','NY','IL','OH','PA','NC')
+--@ define STATE = dist(states)
 with ws_wh as
 (select ws1.ws_order_number,ws1.ws_warehouse_sk wh1,ws2.ws_warehouse_sk wh2
  from web_sales ws1,web_sales ws2

@@ -1,0 +1,210 @@
+"""Audit: every distribution-drawn template parameter must land on the
+generated data's value domain.
+
+This is the guard for the bug class the reference's dsqgen/dsdgen
+pairing prevents by construction (both read the same .dst tables —
+nds/nds_gen_query_stream.py:57-72): a parameter list that matches ZERO
+generated rows silently turns a benchmark query into a no-op (the
+historical query10 county-list bug).
+
+For each template/stream and each `dist(...)`/`distlist(u)` parameter:
+
+* locate the column the parameter predicates on (from the template
+  body: `s_state = '[STATE]'` -> store.s_state),
+* check the drawn value against the generated warehouse column,
+* aggregate per (template, param): hit-rate over streams and the
+  weight MASS of the distribution present in the data.
+
+Failure criterion (deterministic in --rngseed): a param whose
+distribution mass present in the data is < --min_mass (default 0.5).
+Small conditioned tables (12 stores) legitimately miss tail values, so
+single-draw misses are reported but only mass decides pass/fail.
+
+Usage:
+    python scripts/param_audit.py --data DIR [--streams 4]
+    python scripts/param_audit.py --gen-dims /tmp/audit_dims --sf 1
+(--gen-dims generates just the dimension tables it needs, ~20s at SF1.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from ndstpu import schema  # noqa: E402
+from ndstpu.check import check_build  # noqa: E402
+from ndstpu.queries import streamgen  # noqa: E402
+
+# column substring -> (table, column) the audit reads; ordered so the
+# conditioned store_* columns win (mirror of the template-sweep rules)
+COLUMNS = [
+    ("s_gmt_offset", ("store", "s_gmt_offset")),
+    ("ca_gmt_offset", ("customer_address", "ca_gmt_offset")),
+    ("s_county", ("store", "s_county")),
+    ("cc_county", ("call_center", "cc_county")),
+    ("ca_county", ("customer_address", "ca_county")),
+    ("s_state", ("store", "s_state")),
+    ("ca_state", ("customer_address", "ca_state")),
+    ("w_state", ("warehouse", "w_state")),
+    ("s_city", ("store", "s_city")),
+    ("ca_city", ("customer_address", "ca_city")),
+    ("i_category", ("item", "i_category")),
+    ("i_class", ("item", "i_class")),
+    ("i_color", ("item", "i_color")),
+    ("cd_marital_status", ("customer_demographics", "cd_marital_status")),
+    ("cd_education_status", ("customer_demographics",
+                             "cd_education_status")),
+    ("cd_gender", ("customer_demographics", "cd_gender")),
+    ("hd_buy_potential", ("household_demographics", "hd_buy_potential")),
+    ("sm_carrier", ("ship_mode", "sm_carrier")),
+    ("r_reason_desc", ("reason", "r_reason_desc")),
+]
+
+DIM_TABLES = sorted({t for _, (t, _) in COLUMNS})
+
+
+def gen_dims(out_dir: Path, sf: float) -> None:
+    tool = check_build()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for t in DIM_TABLES:
+        subprocess.run([str(tool), "-scale", str(sf), "-dir", str(out_dir),
+                        "-table", t], check=True)
+
+
+def column_values(data_dir: Path, table: str, column: str) -> set:
+    idx = schema.get_schemas(True)[table].column_names.index(column)
+    vals = set()
+    for path in sorted(data_dir.glob(f"{table}_*.dat")) or \
+            sorted(data_dir.glob(f"{table}/*.dat")):
+        with open(path) as f:
+            for line in f:
+                fields = line.rstrip("\n").split("|")
+                if idx < len(fields):
+                    vals.add(fields[idx])
+    return vals
+
+
+def norm(v: str) -> str:
+    """numeric-looking values compare numerically (ca_gmt_offset is
+    written as '-5.00'; the parameter renders as '-5')"""
+    try:
+        return repr(float(v))
+    except ValueError:
+        return v
+
+
+def template_param_columns(tpl_path: Path):
+    """{param: (table, column)} for dist-drawn params, located from the
+    body line(s) the parameter appears in."""
+    text = tpl_path.read_text()
+    params, body = streamgen._parse_template(text)
+    out = {}
+    for name, (kind, vals) in params.items():
+        if kind not in ("dist", "distlist", "distlistu"):
+            continue
+        hits = []
+        for ln in body.splitlines():
+            if f"[{name}]" in ln or f"[{name}." in ln:
+                for col, target in COLUMNS:
+                    if col in ln:
+                        hits.append(target)
+        if hits:
+            # conditioned store columns first (same rule as the sweep)
+            hits.sort(key=lambda t: 0 if t[0] == "store" else 1)
+            out[name] = (hits[0], vals[0])
+        else:
+            out[name] = (None, vals[0])
+    return out
+
+
+def run_audit(data_dir: Path, rngseed: str, streams: int,
+              min_mass: float, template_dir=None) -> dict:
+    col_cache: dict = {}
+
+    def values_for(table, column):
+        if (table, column) not in col_cache:
+            col_cache[(table, column)] = {
+                norm(v) for v in column_values(data_dir, table, column)}
+        return col_cache[(table, column)]
+
+    d = Path(template_dir) if template_dir else streamgen.TEMPLATE_DIR
+    report = {"params": [], "failures": []}
+    for tpl in streamgen.list_templates(template_dir):
+        tpl_path = d / tpl
+        pcols = template_param_columns(tpl_path)
+        if not pcols:
+            continue
+        for name, (target, dname) in pcols.items():
+            if target is None:
+                report["failures"].append(
+                    {"template": tpl, "param": name, "dist": dname,
+                     "error": "no target column found in template body"})
+                continue
+            table, column = target
+            data_vals = values_for(table, column)
+            dist = streamgen._DISTRIBUTIONS[dname]
+            total_w = sum(w for _, w in dist)
+            mass = sum(w for v, w in dist if norm(v) in data_vals) / total_w
+            hits = misses = 0
+            missed_vals = []
+            for s in range(streams):
+                drawn = streamgen.render_params(str(tpl_path), rngseed, s)[name]
+                for v in (drawn if isinstance(drawn, list) else [drawn]):
+                    if norm(v) in data_vals:
+                        hits += 1
+                    else:
+                        misses += 1
+                        missed_vals.append(v)
+            entry = {"template": tpl, "param": name, "dist": dname,
+                     "column": f"{table}.{column}",
+                     "mass_present": round(mass, 4),
+                     "draw_hits": hits, "draw_misses": misses,
+                     "missed_values": sorted(set(missed_vals))}
+            report["params"].append(entry)
+            if mass < min_mass:
+                report["failures"].append(entry)
+    report["n_params"] = len(report["params"])
+    report["n_failures"] = len(report["failures"])
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", help="warehouse dir of generated .dat files")
+    ap.add_argument("--gen-dims",
+                    help="generate the needed dimension tables here first")
+    ap.add_argument("--sf", type=float, default=1.0)
+    ap.add_argument("--rngseed", default="0")
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--min_mass", type=float, default=0.5)
+    ap.add_argument("--template_dir")
+    ap.add_argument("--out", help="write the JSON report here")
+    args = ap.parse_args()
+    if args.gen_dims:
+        gen_dims(Path(args.gen_dims), args.sf)
+        data_dir = Path(args.gen_dims)
+    elif args.data:
+        data_dir = Path(args.data)
+    else:
+        ap.error("need --data or --gen-dims")
+    report = run_audit(data_dir, args.rngseed, args.streams,
+                       args.min_mass, args.template_dir)
+    if args.out:
+        Path(args.out).write_text(json.dumps(report, indent=2))
+    worst = sorted(report["params"], key=lambda e: e["mass_present"])[:8]
+    for e in worst:
+        print(f"{e['template']}:{e['param']} -> {e['column']} "
+              f"mass={e['mass_present']} hits={e['draw_hits']} "
+              f"misses={e['draw_misses']}")
+    print(f"{report['n_params']} dist params audited, "
+          f"{report['n_failures']} failures")
+    return 1 if report["failures"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -99,3 +99,36 @@ def test_single_template_mode(tmp_path):
     from ndstpu.harness.power import gen_sql_from_stream
     qd = gen_sql_from_stream(out[0])
     assert list(qd) == ["query3"]
+
+
+def test_param_audit_all_dist_params_intersect_data(tmp_path):
+    """Every dist-drawn template parameter must land on the generated
+    data's value domain (the dsqgen/dsdgen shared-.dst guarantee; guards
+    the historical query10 zero-match county-list bug).  Generates SF1
+    DIMENSION tables only (~15s) and runs scripts/param_audit.py."""
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                           / "scripts"))
+    import param_audit
+    param_audit.gen_dims(tmp_path, 1.0)
+    report = param_audit.run_audit(tmp_path, rngseed="0", streams=4,
+                                   min_mass=0.5)
+    assert report["n_params"] >= 45, "dist-param sweep regressed"
+    assert report["failures"] == [], report["failures"]
+
+
+def test_dists_json_is_single_source_of_truth():
+    """streamgen's distributions come from ndstpu/datagen/dists.json —
+    the file the native generator compiles against (check.py renders
+    dists_gen.h from it)."""
+    import json
+    from pathlib import Path
+    raw = json.loads((Path(streamgen.__file__).resolve().parent.parent
+                      / "datagen" / "dists.json").read_text())
+    for name, d in raw.items():
+        if name.startswith("_"):
+            continue
+        assert streamgen._DISTRIBUTIONS[name] == \
+            list(zip(d["values"], d["weights"]))
+        assert len(d["values"]) == len(d["weights"])
