@@ -1,0 +1,37 @@
+// GENERATED from dists.json by ndstpu.check.render_dists_header
+// -- do not edit; edit dists.json.
+#pragma once
+struct DistEntry { const char* v; int w; };
+struct DistTable { const DistEntry* e; int n; int total; };
+static const DistEntry kDist_fips_county_e[] = {{"Williamson County", 100}, {"Walker County", 80}, {"Ziebach County", 60}, {"Daviess County", 45}, {"Barrow County", 35}, {"Franklin Parish", 28}, {"Luce County", 22}, {"Richland County", 18}, {"Furnas County", 14}, {"Maverick County", 11}, {"Pennington County", 9}, {"Bronx County", 7}, {"Jackson County", 6}, {"Mesa County", 5}, {"Dauphin County", 4}, {"Levy County", 3}, {"Coal County", 3}, {"Mobile County", 2}, {"San Miguel County", 2}, {"Perry County", 1}};
+static const DistTable kDist_fips_county = {kDist_fips_county_e, 20, 455};
+static const DistEntry kDist_categories_e[] = {{"Women", 18}, {"Men", 15}, {"Children", 12}, {"Shoes", 10}, {"Music", 10}, {"Jewelry", 8}, {"Home", 8}, {"Sports", 7}, {"Books", 6}, {"Electronics", 6}};
+static const DistTable kDist_categories = {kDist_categories_e, 10, 100};
+static const DistEntry kDist_classes_e[] = {{"accent", 4}, {"bathroom", 4}, {"bedding", 5}, {"classical", 3}, {"country", 3}, {"dresses", 6}, {"fragrances", 4}, {"infants", 4}, {"maternity", 4}, {"pants", 6}, {"pop", 4}, {"rock", 3}, {"shirts", 6}, {"swimwear", 3}, {"athletic", 5}, {"casual", 5}, {"formal", 4}, {"mens watch", 2}, {"womens watch", 2}, {"computers", 4}, {"cameras", 3}, {"televisions", 3}, {"football", 3}, {"baseball", 3}, {"basketball", 3}, {"fiction", 4}, {"history", 3}, {"romance", 3}, {"self-help", 2}, {"travel", 2}};
+static const DistTable kDist_classes = {kDist_classes_e, 30, 110};
+static const DistEntry kDist_colors_e[] = {{"red", 12}, {"blue", 12}, {"green", 10}, {"yellow", 8}, {"purple", 7}, {"orange", 7}, {"black", 10}, {"white", 10}, {"pink", 6}, {"brown", 6}, {"gray", 5}, {"cyan", 3}, {"magenta", 3}, {"ivory", 4}, {"khaki", 4}, {"lavender", 4}, {"maroon", 4}, {"navy", 5}, {"olive", 4}, {"salmon", 4}, {"tan", 4}, {"teal", 4}, {"turquoise", 3}, {"violet", 3}, {"beige", 4}, {"azure", 2}, {"chartreuse", 2}, {"coral", 3}, {"crimson", 3}, {"gold", 4}, {"silver", 4}, {"plum", 2}, {"orchid", 2}, {"peach", 3}, {"mint", 2}, {"rose", 3}, {"ghost", 1}, {"snow", 2}, {"seashell", 1}, {"linen", 1}};
+static const DistTable kDist_colors = {kDist_colors_e, 40, 181};
+static const DistEntry kDist_states_e[] = {{"AL", 10}, {"AK", 2}, {"AZ", 9}, {"AR", 6}, {"CA", 35}, {"CO", 10}, {"CT", 6}, {"DE", 2}, {"FL", 25}, {"GA", 15}, {"HI", 2}, {"ID", 3}, {"IL", 20}, {"IN", 12}, {"IA", 7}, {"KS", 6}, {"KY", 8}, {"LA", 8}, {"ME", 3}, {"MD", 8}, {"MA", 10}, {"MI", 15}, {"MN", 9}, {"MS", 6}, {"MO", 11}, {"MT", 2}, {"NE", 4}, {"NV", 4}, {"NH", 2}, {"NJ", 12}, {"NM", 4}, {"NY", 28}, {"NC", 14}, {"ND", 2}, {"OH", 18}, {"OK", 7}, {"OR", 7}, {"PA", 19}, {"RI", 2}, {"SC", 8}, {"SD", 2}, {"TN", 11}, {"TX", 30}, {"UT", 5}, {"VT", 2}, {"VA", 12}, {"WA", 11}, {"WV", 4}, {"WI", 10}, {"WY", 2}};
+static const DistTable kDist_states = {kDist_states_e, 50, 470};
+static const DistEntry kDist_cities_e[] = {{"Midway", 40}, {"Fairview", 35}, {"Oakland", 20}, {"Springdale", 15}, {"Salem", 12}, {"Georgetown", 10}, {"Ashland", 9}, {"Riverside", 8}, {"Greenville", 8}, {"Franklin", 7}, {"Clinton", 6}, {"Marion", 6}, {"Bethel", 5}, {"Oakdale", 5}, {"Union", 5}, {"Wilson", 4}, {"Glendale", 4}, {"Centerville", 4}, {"Hopewell", 3}, {"Lakeview", 3}, {"Pleasant Hill", 3}, {"Mount Olive", 3}, {"Shiloh", 2}, {"Five Points", 2}, {"Oak Grove", 2}, {"Newport", 2}, {"Woodville", 2}, {"Concord", 2}, {"Antioch", 1}, {"Friendship", 1}};
+static const DistTable kDist_cities = {kDist_cities_e, 30, 229};
+static const DistEntry kDist_store_cities_e[] = {{"Midway", 40}, {"Fairview", 35}, {"Oakland", 12}, {"Springdale", 6}, {"Salem", 4}, {"Georgetown", 3}};
+static const DistTable kDist_store_cities = {kDist_store_cities_e, 6, 100};
+static const DistEntry kDist_store_states_e[] = {{"TN", 30}, {"GA", 20}, {"TX", 15}, {"CA", 10}, {"OH", 8}, {"IL", 7}, {"NY", 6}, {"FL", 4}};
+static const DistTable kDist_store_states = {kDist_store_states_e, 8, 100};
+static const DistEntry kDist_store_gmt_e[] = {{"-5", 60}, {"-6", 40}};
+static const DistTable kDist_store_gmt = {kDist_store_gmt_e, 2, 100};
+static const DistEntry kDist_gmt_offset_e[] = {{"-5", 35}, {"-6", 30}, {"-7", 12}, {"-8", 15}, {"-9", 4}, {"-10", 4}};
+static const DistTable kDist_gmt_offset = {kDist_gmt_offset_e, 6, 100};
+static const DistEntry kDist_education_e[] = {{"Primary", 12}, {"Secondary", 18}, {"College", 20}, {"2 yr Degree", 14}, {"4 yr Degree", 18}, {"Advanced Degree", 10}, {"Unknown", 8}};
+static const DistTable kDist_education = {kDist_education_e, 7, 100};
+static const DistEntry kDist_marital_status_e[] = {{"M", 30}, {"S", 28}, {"D", 18}, {"W", 12}, {"U", 12}};
+static const DistTable kDist_marital_status = {kDist_marital_status_e, 5, 100};
+static const DistEntry kDist_gender_e[] = {{"M", 50}, {"F", 50}};
+static const DistTable kDist_gender = {kDist_gender_e, 2, 100};
+static const DistEntry kDist_buy_potential_e[] = {{"0-500", 18}, {"501-1000", 16}, {"1001-5000", 22}, {"5001-10000", 16}, {">10000", 14}, {"Unknown", 14}};
+static const DistTable kDist_buy_potential = {kDist_buy_potential_e, 6, 100};
+static const DistEntry kDist_carriers_e[] = {{"UPS", 1}, {"FEDEX", 1}, {"AIRBORNE", 1}, {"USPS", 1}, {"DHL", 1}, {"TBS", 1}, {"ZHOU", 1}, {"GREAT EASTERN", 1}, {"DIAMOND", 1}, {"RUPEKSA", 1}, {"ORIENTAL", 1}, {"BOXBUNDLES", 1}, {"ALLIANCE", 1}, {"GERMA", 1}, {"HARMSTORF", 1}, {"PRIVATECARRIER", 1}, {"MSC", 1}, {"LATVIAN", 1}, {"ZOUROS", 1}, {"GLOBAL", 1}};
+static const DistTable kDist_carriers = {kDist_carriers_e, 20, 20};
+static const DistEntry kDist_reasons_e[] = {{"Package was damaged", 1}, {"Stopped working", 1}, {"Did not get it on time", 1}, {"Not the product that was ordred", 1}, {"Parts missing", 1}, {"Does not work with a product that I have", 1}, {"Gift exchange", 1}, {"Did not like the color", 1}, {"Did not like the model", 1}, {"Did not like the make", 1}, {"Did not like the warranty", 1}, {"No service location in my area", 1}, {"Found a better price in a store", 1}, {"Found a better extended warranty", 1}, {"reason 15", 1}, {"reason 16", 1}, {"reason 17", 1}, {"reason 18", 1}, {"reason 19", 1}, {"reason 20", 1}, {"reason 21", 1}, {"reason 22", 1}, {"reason 23", 1}, {"reason 24", 1}, {"reason 25", 1}, {"reason 26", 1}, {"reason 27", 1}, {"reason 28", 1}, {"reason 29", 1}, {"reason 30", 1}, {"reason 31", 1}, {"reason 32", 1}, {"reason 33", 1}, {"reason 34", 1}, {"reason 35", 1}};
+static const DistTable kDist_reasons = {kDist_reasons_e, 35, 35};

@@ -376,7 +376,13 @@ static Sizes compute_sizes(double sf) {
   z.inventory = z.inv_weeks * (z.item / 2 < 1 ? 1 : z.item / 2) * z.warehouse;
   z.customer = step_count(sf, kCustomer, false);
   z.customer_address = step_count(sf, kCustomerAddress, false);
-  z.customer_demographics = 1920800;
+  // full cross product of the demographic attributes — derived from
+  // the SHARED dist tables so a dists.json edit cannot silently
+  // truncate coverage (gender x marital x education x 20 purchase
+  // estimates x 4 credit ratings x 7^3 dependent counts = 1,920,800
+  // at the spec sizes, locked by test_spec_step_table_cardinalities)
+  z.customer_demographics = (int64_t)kDist_gender.n *
+      kDist_marital_status.n * kDist_education.n * 20 * 4 * 7 * 7 * 7;
   z.household_demographics = 7200;
   z.income_band = 20;
   z.store = step_count(sf, kStore, false);

@@ -44,6 +44,7 @@ import os
 import dataclasses
 import math
 import re
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -1213,7 +1214,15 @@ class JaxExecutor:
         import os as _os
         self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "auto")
         self.groupby_domain_cap = int(
-            _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 16)))
+            _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 21)))
+        # 1<<16 left q2's pivoted (d_week_seq x d_day_name) composite
+        # key (~83k slots) — and q59's (week x store x day, ~1.17M) —
+        # on the SORT path: a full multi-key sort of the 2-3M-row fact
+        # spine that costs more than the masked scatters the pivot
+        # removed.  Slot buffers are ngseg-sized (1<<21 x 8B = 16 MB
+        # per reduction, freed per aggregate), trivial next to the row
+        # data; sparse scatter output stays cheaper than sorting
+        # millions of rows.
         # LUT-join domain cap: counts/starts tables of `bound` slots live
         # in HBM (2 x 4B x bound; 1<<25 -> 256 MB peak, freed per join)
         self.join_lut_cap = int(
@@ -1722,11 +1731,23 @@ class JaxExecutor:
             # keys at 4M cost ~0.5 s in eager gathers otherwise)
             out_cols = _gather_cols(dict(key_cols), rep, out_alive)
         else:
+            # keyless (scalar) aggregate: TWO segments (alive row 0,
+            # dead row 1).  The old path used ngseg=cap — a cap-sized
+            # scatter target per aggregate — and eagerly lexsorted the
+            # whole capacity by a 0/1 key; q28's six scalar-agg
+            # branches paid six full sorts for nothing.  The sort is
+            # now lazy (only a float df64 sum needs gid-contiguous
+            # order) and reductions land in 2 slots.
             gid = jnp.where(dt.alive, 0, 1).astype(jnp.int32)
-            order = _lexsort_order([gid])
-            ngseg = cap
-            out_alive = jnp.zeros(cap, bool).at[0].set(True)
+            ngseg = 2
+            out_alive = jnp.asarray([True, False])
             out_cols = {}
+            memo_o: Dict[str, object] = {}
+
+            def order(memo=memo_o, g=gid):
+                if "o" not in memo:
+                    memo["o"] = _lexsort_order([g])
+                return memo["o"]
         # gid-sorted row order rides alongside gid: float sums use the
         # compensated segmented scan (ndstpu.engine.df64).  Passed as a
         # parameter, NOT instance state — _resolve_subqueries may run a
@@ -2072,13 +2093,35 @@ class JaxExecutor:
             return DCol(data, ok, FLOAT64)
         raise Unsupported(f"aggregate {func}")
 
+    # presence-bitmap distinct: ngseg x domain slots; 1<<22 int32 slots
+    # = 16 MB peak, freed per aggregate
+    _DISTINCT_BITMAP_SLOTS = 1 << 22
+
     def _agg_distinct(self, dt: DTable, evl: JEval, a: ex.AggExpr,
                       gid, ngseg) -> DCol:
-        """count/sum/avg(DISTINCT x): sort (group, value), keep the first
-        row of each distinct pair, then segment-combine as usual."""
+        """count/sum/avg(DISTINCT x).
+
+        Small-domain int/decimal columns (static bounds) use a
+        presence BITMAP: scatter 1s into (segment, value-lo) slots and
+        reduce rows of the dense (ngseg, domain) array — no sort.
+        q28's six count(distinct ss_list_price) branches each paid a
+        full-capacity 2-key sort over store_sales this replaces.  The
+        branch choice derives ONLY from static metadata (ctype, bounds,
+        ngseg), so discovery and replay always agree; replay guards
+        values escaping the recorded bounds via the ok-mask like the
+        group-by linearizer.  Everything else keeps the sort path:
+        sort (group, value), keep the first row of each distinct pair,
+        segment-combine as usual."""
         func = a.func
         c = evl.eval(a.arg)
         valid = c.valid & dt.alive
+        if c.ctype.kind in ("decimal", "int32", "int64") and \
+                c.bounds is not None:
+            lo, hi = c.bounds
+            domain = int(hi - lo + 1)
+            if 0 < domain and ngseg * domain <= self._DISTINCT_BITMAP_SLOTS:
+                return self._agg_distinct_bitmap(
+                    c, valid, gid, ngseg, lo, domain, func)
         vkey = _key_col(c, dt.alive)
         order = _lexsort_order([gid, vkey])
         gid_s = gid[order]
@@ -2110,6 +2153,35 @@ class JaxExecutor:
         if func == "sum":
             return DCol(sums, got, FLOAT64)
         return DCol(sums / jnp.maximum(cnts, 1), got, FLOAT64)
+
+    def _agg_distinct_bitmap(self, c: DCol, valid, gid, ngseg: int,
+                             lo: int, domain: int, func: str) -> DCol:
+        raw = c.data.astype(jnp.int64) - lo
+        in_dom = (raw >= 0) & (raw < domain)
+        use = valid & in_dom
+        if self.mode == "replay":
+            # a valid value outside the recorded bounds means the data
+            # changed under this size class: fail the guard, rediscover
+            self._oks.append(~jnp.any(valid & ~in_dom))
+        idx = gid.astype(jnp.int64) * domain + jnp.clip(raw, 0, domain - 1)
+        idx = jnp.where(use, idx, ngseg * domain)  # trash slot
+        seen = jnp.zeros(ngseg * domain + 1, jnp.int32).at[idx].max(
+            use.astype(jnp.int32))
+        seen2 = seen[:-1].reshape(ngseg, domain)
+        cnts = seen2.sum(axis=1).astype(jnp.int64)
+        if func == "count":
+            return DCol(cnts, jnp.ones(ngseg, bool), INT64)
+        got = cnts > 0
+        slot_vals = lo + jnp.arange(domain, dtype=jnp.int64)
+        sums = (seen2.astype(jnp.int64) * slot_vals[None, :]).sum(axis=1)
+        if func == "sum":
+            if c.ctype.kind == "decimal":
+                return DCol(sums, got, decimal(38, c.ctype.scale))
+            return DCol(sums, got, INT64)
+        mean = sums.astype(jnp.float64) / jnp.maximum(cnts, 1)
+        if c.ctype.kind == "decimal":
+            mean = mean / (10 ** c.ctype.scale)
+        return DCol(mean, got, FLOAT64)
 
     # -- window --------------------------------------------------------------
 
@@ -2934,6 +3006,17 @@ class CompilingExecutor(JaxExecutor):
         # inside discovery (every query's first execution), not on
         # steady-state demoted eager aggregates
         self._in_discovery = False
+        # opt-in per-query attribution (NDSTPU_ATTRIB=1): splits a
+        # replay into host-arg-build / device-compute / result-fetch
+        # spans and records fetched bytes + XLA cost-analysis flops so
+        # a query can be classified dispatch-, transfer-, or
+        # compute-bound (the wall clock alone cannot say which —
+        # SURVEY §5: the reference has only wall-clock).  Off by
+        # default: the extra block_until_ready serializes the device
+        # pipeline.
+        self.attrib_enabled = os.environ.get(
+            "NDSTPU_ATTRIB", "0") not in ("", "0")
+        self.last_attribution: Optional[dict] = None
 
     def execute_cached(self, p: lp.Plan, key: str) -> Table:
         versions = tuple(sorted(
@@ -3015,8 +3098,11 @@ class CompilingExecutor(JaxExecutor):
         """Dispatch segment programs then the parent; ONE batched
         device->host fetch at the end (a fetch costs a tunnel round
         trip).  None = some size guard failed (data changed)."""
+        attrib = self.attrib_enabled
+        t_start = time.perf_counter() if attrib else 0.0
         seg_args = {}
         seg_oks = []
+        seg_flop_args: list = []
         for fp in (cp.seg_fps or ()):
             scp = self._seg_compiled.get(fp)
             if scp is None or scp.versions != cp.versions:
@@ -3026,6 +3112,8 @@ class CompilingExecutor(JaxExecutor):
                     scp.fn = self._build_jit(scp)
                 args = {t: self._accel_args(t, c)
                         for t, c in scp.table_cols.items()}
+                if attrib:
+                    seg_flop_args.append((scp, args))
                 (out, alive), ok = scp.fn(args)
                 seg_args[_seg_argname(fp)] = (out, alive)
                 seg_oks.append(ok)
@@ -3038,9 +3126,27 @@ class CompilingExecutor(JaxExecutor):
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
         args.update(seg_args)
+        t_dispatch = time.perf_counter() if attrib else 0.0
         (out, alive), ok = cp.fn(args)
+        if attrib:
+            # serialize: device span ends when every output is ready,
+            # fetch span is then the pure device->host transfer
+            jax.block_until_ready(((out, alive), ok))
+            t_ready = time.perf_counter()
         (out, alive_np), okv, seg_okv = jax.device_get(
             ((out, alive), ok, seg_oks))
+        if attrib:
+            t_fetched = time.perf_counter()
+            fetched = int(alive_np.nbytes) + sum(
+                d.nbytes + v.nbytes for d, v in out.values())
+            self.last_attribution = {
+                "host_prep_s": round(t_dispatch - t_start, 5),
+                "device_s": round(t_ready - t_dispatch, 5),
+                "fetch_s": round(t_fetched - t_ready, 5),
+                "fetched_bytes": fetched,
+                "n_programs": 1 + len(cp.seg_fps or ()),
+                "flops": self._cost_flops(cp, args, seg_flop_args),
+            }
         if not (bool(okv) and all(bool(o) for o in seg_okv)):
             return None
         for fp in (cp.seg_fps or ()):
@@ -3049,6 +3155,34 @@ class CompilingExecutor(JaxExecutor):
                 scp.preloaded = False
                 scp.fn_validated = True
         return self._assemble_host(cp, out, alive_np)
+
+    def _cost_flops(self, cp: _CompiledPlan, args,
+                    seg_flop_args) -> Optional[float]:
+        """XLA cost-analysis flops of the parent + compiled segment
+        programs (drives MFU = flops / device_s / peak_flops).  Each
+        program is re-lowered ONCE to reach cost_analysis (tracing can
+        take seconds on CTE-heavy queries), then cached on its
+        _CompiledPlan.  None when the backend offers no analysis."""
+
+        def one(plan_cp, plan_args) -> float:
+            cached = getattr(plan_cp, "cost_flops", None)
+            if cached is not None:
+                return cached
+            an = plan_cp.fn.lower(plan_args).compile().cost_analysis()
+            if isinstance(an, (list, tuple)):
+                flops = sum(float(d.get("flops", 0.0)) for d in an if d)
+            else:
+                flops = float(an.get("flops", 0.0))
+            plan_cp.cost_flops = flops
+            return flops
+
+        try:
+            total = one(cp, args)
+            for scp, sargs in seg_flop_args:
+                total += one(scp, sargs)
+            return total
+        except Exception:
+            return None
 
     @staticmethod
     def _assemble_host(cp: _CompiledPlan, out, alive_np) -> Table:

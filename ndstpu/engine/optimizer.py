@@ -15,6 +15,7 @@ are globally unique ("alias.col"), which makes substitution trivial.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Set, Tuple
 
 from ndstpu.engine import expr as ex, plan as lp
@@ -480,6 +481,210 @@ def _plan_exprs(p: lp.Plan) -> List[ex.Expr]:
     return []
 
 
+def _pivot_sum_case(e: ex.Expr):
+    """Match ``sum(CASE WHEN scrut = lit THEN value END)`` (the TPC-DS
+    day-of-week / channel pivot idiom); -> (scrut, lit, value) or None."""
+    if not isinstance(e, ex.AggExpr) or e.func != "sum" or e.distinct:
+        return None
+    c = e.arg
+    if not isinstance(c, ex.Case) or len(c.whens) != 1:
+        return None
+    if c.default is not None and not (
+            isinstance(c.default, ex.Literal) and c.default.value is None):
+        return None
+    cond, val = c.whens[0]
+    if not (isinstance(cond, ex.BinOp) and cond.op == "="):
+        return None
+    if isinstance(cond.right, ex.Literal) and \
+            not isinstance(cond.left, ex.Literal):
+        return cond.left, cond.right, val
+    if isinstance(cond.left, ex.Literal) and \
+            not isinstance(cond.right, ex.Literal):
+        return cond.right, cond.left, val
+    return None
+
+
+def _try_pivot(p: lp.Aggregate) -> Optional[lp.Plan]:
+    if p.grouping_sets is not None or not p.aggs:
+        return None
+    pivots: Dict[int, tuple] = {}
+    plains: Dict[int, ex.AggExpr] = {}
+    for i, (_name, e) in enumerate(p.aggs):
+        pat = _pivot_sum_case(e)
+        if pat is not None:
+            pivots[i] = pat
+        elif isinstance(e, ex.AggExpr) and not e.distinct and \
+                e.func in ("sum", "count", "min", "max"):
+            plains[i] = e
+        else:
+            return None
+    if len(pivots) < 3:
+        return None
+    scrut = None
+    for s, _lit, _v in pivots.values():
+        if scrut is None:
+            scrut = s
+        elif s != scrut:  # frozen expr dataclasses: structural equality
+            return None
+    vals: List[ex.Expr] = []
+    for _s, _lit, v in pivots.values():
+        if all(v != u for u in vals):
+            vals.append(v)
+
+    l1_aggs: List[tuple] = [
+        (f"__pv_v{j}", ex.AggExpr("sum", v)) for j, v in enumerate(vals)]
+    for i, e in plains.items():
+        l1_aggs.append((f"__pv_p{i}", ex.AggExpr(e.func, e.arg)))
+    l1 = lp.Aggregate(p.child, list(p.group_by) + [("__pv_s", scrut)],
+                      l1_aggs, None)
+
+    l2_groups = [(n, ex.ColumnRef(n)) for n, _e in p.group_by]
+    l2_aggs: List[tuple] = []
+    for i, (name, e) in enumerate(p.aggs):
+        if i in pivots:
+            _s, lit, v = pivots[i]
+            j = next(j for j, u in enumerate(vals) if u == v)
+            cond = ex.BinOp("=", ex.ColumnRef("__pv_s"), lit)
+            l2_aggs.append((name, ex.AggExpr(
+                "sum", ex.Case(((cond, ex.ColumnRef(f"__pv_v{j}")),),
+                               ex.Literal(None, None)))))
+        else:
+            e = plains[i]
+            # counts recombine by SUM; min/max by min/max.  Partial
+            # counts are never NULL, but a KEYLESS rewrite over empty
+            # input has zero partial rows and sum-over-nothing is NULL
+            # where count must be 0 — coalesce restores the contract
+            # (grouped aggregates can't hit this: empty groups don't
+            # exist on either side).
+            func = "sum" if e.func in ("sum", "count") else e.func
+            recombined: ex.Expr = ex.AggExpr(
+                func, ex.ColumnRef(f"__pv_p{i}"))
+            if e.func == "count" and not p.group_by:
+                recombined = ex.Func(
+                    "coalesce", (recombined, ex.Literal(0, None)))
+            l2_aggs.append((name, recombined))
+    return lp.Aggregate(l1, l2_groups, l2_aggs, None)
+
+
+def _refs_counter(p: lp.Plan, out) -> None:
+    for e in _plan_exprs(p):
+        for n in e.walk():
+            if isinstance(n, ex.ColumnRef):
+                out[n.name] += 1
+    for c in p.children():
+        _refs_counter(c, out)
+
+
+def null_filter_to_anti(p: lp.Plan) -> lp.Plan:
+    """``Filter(right_key IS NULL, LEFT JOIN)`` -> ANTI JOIN.
+
+    The q78-family refresh-exclusion idiom (``left join store_returns
+    on sr_ticket_number = ss_ticket_number ... where sr_ticket_number
+    is null``) materializes the full joined width with duplicate-key
+    run expansion, then throws the matches away; an anti join is a
+    mask over the probe side.  Sound because equality keys never match
+    NULLs: a surviving row's right columns are all NULL, so the
+    conversion wraps the anti join in a Project restoring each right
+    KEY column as a NULL literal (prune drops the unreferenced ones).
+    A reference to any NON-key right column — from the remaining
+    conjuncts OR any ancestor node (the select list may legally emit
+    an all-NULL right column) — blocks the rewrite: that name would no
+    longer resolve.  Ancestor references are detected by ref-count
+    difference against the whole tree (planner invariant: column names
+    are globally unique)."""
+    import collections
+    while True:
+        total = collections.Counter()
+        _refs_counter(p, total)
+        p, changed = _null_filter_to_anti(p, total)
+        if not changed:
+            return p
+
+
+def _null_filter_to_anti(p: lp.Plan, total):
+    """One rewrite per call (the ref-count snapshot goes stale once the
+    tree changes); returns (plan, changed)."""
+    for f in dataclasses.fields(p):
+        v = getattr(p, f.name)
+        if isinstance(v, lp.Plan):
+            nv, changed = _null_filter_to_anti(v, total)
+            if changed:
+                setattr(p, f.name, nv)
+                return p, True
+    if not (isinstance(p, lp.Filter) and isinstance(p.child, lp.Join)
+            and p.child.kind == "left" and p.child.keys
+            and p.child.extra is None):
+        return p, False
+    j = p.child
+    try:
+        right_names = set(_output_names(j.right))
+        left_names = _output_names(j.left)
+    except RuntimeError:
+        return p, False
+    right_keys = {e.name for _l, e in j.keys
+                  if isinstance(e, ex.ColumnRef)}
+    if len(right_keys) != len(j.keys):
+        return p, False  # a computed right key: cannot restore as NULL
+    rest = []
+    fired = False
+    for c in _conjuncts(p.condition):
+        if not fired and isinstance(c, ex.UnaryOp) and \
+                c.op == "isnull" and \
+                isinstance(c.operand, ex.ColumnRef) and \
+                c.operand.name in right_keys:
+            fired = True
+            continue
+        rest.append(c)
+    if not fired or any(_refs(c) & (right_names - right_keys)
+                        for c in rest):
+        return p, False
+    # ancestor-reference guard: every reference to a non-key right
+    # column must live inside THIS subtree (conjuncts already checked
+    # reference none, so any count surplus is an ancestor's)
+    import collections
+    inside = collections.Counter()
+    _refs_counter(p, inside)
+    for name in right_names - right_keys:
+        if total[name] > inside[name]:
+            return p, False
+    j.kind = "anti"
+    out: lp.Plan = lp.Project(
+        j, [(n, ex.ColumnRef(n)) for n in left_names] +
+           [(n, ex.Literal(None, None)) for n in sorted(right_keys)])
+    remaining = _conjoin(rest)
+    if remaining is not None:
+        out = lp.Filter(out, remaining)
+    return out, True
+
+
+def pivot_case_aggregates(p: lp.Plan) -> lp.Plan:
+    """Rewrite N-way masked-sum pivots into ONE composite-key
+    aggregation plus a tiny re-aggregation.
+
+    q2/q59-class aggregates compute 7 ``sum(case when d_day_name='X'
+    then price end)`` columns: each is a full-capacity masked segment
+    sum over the fact spine, and exact decimals make every sum an
+    int64-emulated scatter (54 scatter ops, ~3.7 s device time on q2 at
+    SF1).  Grouping by (keys..., scrutinee) instead computes ONE sum
+    over the spine; the second-level re-aggregation runs over the
+    compacted (keys x scrutinee-domain) partial table (~10k rows).
+    Decimal sums recombine exactly (sum of int64-scaled sums); NULL
+    semantics are preserved: a (g, s) partial is NULL iff it saw no
+    valid value, and absent combinations contribute no rows, so the
+    outer sum is NULL exactly when the direct masked sum would be.
+    Float-mode sums change association order; the differential
+    harness's epsilon (1e-5 relative) covers that drift."""
+    for f in dataclasses.fields(p):
+        v = getattr(p, f.name)
+        if isinstance(v, lp.Plan):
+            setattr(p, f.name, pivot_case_aggregates(v))
+    if isinstance(p, lp.Aggregate):
+        out = _try_pivot(p)
+        if out is not None:
+            return out
+    return p
+
+
 def _optimize_embedded(p: lp.Plan, catalog) -> None:
     """Optimize plans embedded in SubqueryExpr leaves (uncorrelated scalar /
     IN subqueries survive planning as expressions — without this their join
@@ -495,6 +700,8 @@ def _optimize_embedded(p: lp.Plan, catalog) -> None:
 def optimize(p: lp.Plan, catalog=None) -> lp.Plan:
     p = push_filters(p)
     p = reorder_joins(p, catalog)
+    p = pivot_case_aggregates(p)
+    p = null_filter_to_anti(p)
     p = prune(p, None)
     _optimize_embedded(p, catalog)
     return p

@@ -1,0 +1,177 @@
+"""Export an `ndslake`/`ndsdelta` table as a STANDARD Delta Lake table.
+
+The framework's two ACID formats are functionally equivalent to
+Iceberg/Delta (snapshots, deletes, RESTORE) but private; the reference's
+maintenance phase targets catalogs any engine can read
+(/root/reference/nds/nds_power.py:107-121,
+convert_submit_cpu_delta.template:24-27).  This module closes that gap
+with a snapshot export: the table's CURRENT state becomes a minimal but
+protocol-correct Delta table — `_delta_log/...0.json` carrying
+`protocol` (reader 1 / writer 2), `metaData` (Spark-JSON schemaString
+derived from the parquet schema), and one `add` per data file with
+size, modificationTime and partitionValues — which delta-rs, Spark
+Delta, DuckDB delta, Trino etc. read directly.  Data files are linked
+(hard link, falling back to copy), not rewritten.
+
+CLI:
+    python -m ndstpu.io.delta_export TABLE_DIR OUT_DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def _spark_type(t: pa.DataType) -> object:
+    if pa.types.is_boolean(t):
+        return "boolean"
+    if pa.types.is_int8(t) or pa.types.is_int16(t):
+        return "short"
+    if pa.types.is_int32(t):
+        return "integer"
+    if pa.types.is_int64(t):
+        return "long"
+    if pa.types.is_float32(t):
+        return "float"
+    if pa.types.is_float64(t):
+        return "double"
+    if pa.types.is_decimal(t):
+        return f"decimal({t.precision},{t.scale})"
+    if pa.types.is_date(t):
+        return "date"
+    if pa.types.is_timestamp(t):
+        return "timestamp"
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "string"
+    if pa.types.is_binary(t):
+        return "binary"
+    if pa.types.is_dictionary(t):
+        return _spark_type(t.value_type)
+    raise ValueError(f"no Spark type mapping for arrow type {t}")
+
+
+def schema_string(schema: pa.Schema) -> str:
+    """Arrow schema -> Spark StructType JSON (the Delta metaData
+    schemaString contract)."""
+    fields = [{"name": f.name, "type": _spark_type(f.type),
+               "nullable": True, "metadata": {}} for f in schema]
+    return json.dumps({"type": "struct", "fields": fields})
+
+
+def _snapshot_files(table_dir: str) -> List[str]:
+    """Absolute paths of the data files making up the CURRENT state."""
+    from ndstpu.io import acid, deltalog
+    if deltalog.is_ndsdelta(table_dir):
+        st = deltalog._replay(table_dir)
+        return [os.path.join(table_dir, p) for p in st.files]
+    if acid.is_ndslake(table_dir):
+        snap = acid.load_snapshot(table_dir)
+        return [os.path.join(table_dir, f["path"]) for f in snap.files]
+    # plain parquet dir exports too (trivial snapshot)
+    parts = sorted(
+        os.path.join(table_dir, n) for n in os.listdir(table_dir)
+        if n.endswith(".parquet"))
+    if not parts:
+        raise FileNotFoundError(f"no exportable table at {table_dir}")
+    return parts
+
+
+def _materialized_residual(table_dir: str) -> Optional[pa.Table]:
+    """ndslake deletion vectors are merge-on-read: files with pending
+    deletes cannot be linked as-is.  Returns the fully-materialized
+    table when residual deletes exist, else None (zero-copy path)."""
+    from ndstpu.io import acid
+    if acid.is_ndslake(table_dir):
+        snap = acid.load_snapshot(table_dir)
+        if any(f.get("deletes") for f in snap.files):
+            return acid.read(table_dir)
+    return None
+
+
+def export(table_dir: str, out_dir: str) -> dict:
+    """Write OUT_DIR as a standard Delta table of TABLE_DIR's current
+    snapshot; returns a manifest summary."""
+    os.makedirs(os.path.join(out_dir, "_delta_log"), exist_ok=True)
+    adds = []
+    ts_ms = int(time.time() * 1000)
+    residual = _materialized_residual(table_dir)
+    if residual is not None:
+        rel = f"part-00000-{uuid.uuid4().hex}-c000.snappy.parquet"
+        pq.write_table(residual, os.path.join(out_dir, rel),
+                       compression="snappy")
+        files = [os.path.join(out_dir, rel)]
+        linked = False
+    else:
+        files = _snapshot_files(table_dir)
+        linked = True
+    schema = None
+    total_rows = 0
+    for src in files:
+        if linked:
+            rel = f"part-{uuid.uuid4().hex}-c000.snappy.parquet"
+            dst = os.path.join(out_dir, rel)
+            try:
+                os.link(src, dst)
+            except OSError:
+                shutil.copy2(src, dst)
+        else:
+            rel = os.path.basename(src)
+            dst = src
+        md = pq.read_metadata(dst)
+        total_rows += md.num_rows
+        if schema is None:
+            schema = pq.read_schema(dst)
+        adds.append({"add": {
+            "path": rel,
+            "partitionValues": {},
+            "size": os.path.getsize(dst),
+            "modificationTime": ts_ms,
+            "dataChange": True,
+        }})
+    if schema is None:
+        raise FileNotFoundError(f"no data files in {table_dir}")
+    actions = [
+        {"commitInfo": {"timestamp": ts_ms,
+                        "operation": "WRITE",
+                        "operationParameters": {"mode": "ErrorIfExists"},
+                        "engineInfo": "ndstpu-delta-export"}},
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": schema_string(schema),
+            "partitionColumns": [],
+            "configuration": {},
+            "createdTime": ts_ms,
+        }},
+    ] + adds
+    log_path = os.path.join(out_dir, "_delta_log", f"{0:020d}.json")
+    tmp = log_path + f".tmp.{uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(json.dumps(a) for a in actions) + "\n")
+    os.replace(tmp, log_path)
+    return {"files": len(adds), "rows": total_rows, "log": log_path}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="export an ndslake/ndsdelta table as standard Delta")
+    ap.add_argument("table_dir")
+    ap.add_argument("out_dir")
+    args = ap.parse_args()
+    info = export(args.table_dir, args.out_dir)
+    print(json.dumps(info))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

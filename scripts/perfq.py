@@ -64,15 +64,43 @@ def main() -> int:
                   f"steady={steady:7.3f}s rows={out.num_rows}",
                   flush=True)
             if args.hlo and cp is not None and cp.fn is not None:
+                from ndstpu.engine import jaxexec
                 exe = sess._jax_executor()
+                ops = collections.Counter()
+                # segmented queries: run each segment to materialize the
+                # device-resident arg the parent's lowering needs, and
+                # histogram the segment programs too
                 targs = {t: exe._accel_args(t, cols)
                          for t, cols in cp.table_cols.items()}
+                skipped_segs = 0
+                for fp in (cp.seg_fps or ()):
+                    scp = exe._seg_compiled[fp]
+                    if not scp.compilable:
+                        # fallback-isolated segment: replay runs it on
+                        # the host; feed its result like _replay does
+                        host = exe.execute_to_host(scp.plan)
+                        targs[jaxexec._seg_argname(fp)] = \
+                            exe._seg_host_args(scp, host)
+                        skipped_segs += 1
+                        continue
+                    if scp.fn is None:
+                        scp.fn = exe._build_jit(scp)
+                    sargs = {t: exe._accel_args(t, c)
+                             for t, c in scp.table_cols.items()}
+                    (sout, salive), _ok = scp.fn(sargs)
+                    targs[jaxexec._seg_argname(fp)] = (sout, salive)
+                    stxt = scp.fn.lower(sargs).as_text()
+                    ops.update(re.findall(r"stablehlo\.(\w+)", stxt))
+                if skipped_segs:
+                    print(f"  ({skipped_segs} host-fallback segs "
+                          f"not in histogram)", flush=True)
                 txt = cp.fn.lower(targs).as_text()
-                ops = collections.Counter(
-                    re.findall(r"stablehlo\.(\w+)", txt))
+                ops.update(re.findall(r"stablehlo\.(\w+)", txt))
                 total = sum(ops.values())
                 top = ", ".join(f"{k}:{v}" for k, v in ops.most_common(18))
-                print(f"  ops={total}  {top}", flush=True)
+                nseg = len(cp.seg_fps or ())
+                print(f"  ops={total} (parent+{nseg} segs)  {top}",
+                      flush=True)
     return 0
 
 
