@@ -1211,8 +1211,17 @@ class JaxExecutor:
         # "pallas" = auto + one-hot MXU segment sums for exact
         # decimal/int aggregates (ndstpu.ops.segsum).  Read once per
         # executor: the choice is baked into traced programs.
+        # Default is pallas since the r5 Mosaic fix: XLA's int64
+        # scatter emulation costs 247 ms at 4M rows x 1024 segments
+        # where the limb kernel takes 3.6 ms (69x; 5.8x at 18k
+        # segments) — scripts/pallas_bench.py, measured on chip.
+        # The kernel only engages where it would COMPILE (TPU replay);
+        # interpret-mode execution (CPU platforms, eager/discovery
+        # passes) keeps the scatter path unless NDSTPU_GROUPBY=pallas
+        # is set explicitly (tests use that for interpreter coverage).
         import os as _os
-        self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "auto")
+        self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "pallas")
+        self._groupby_explicit = "NDSTPU_GROUPBY" in _os.environ
         self.groupby_domain_cap = int(
             _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 21)))
         # 1<<16 left q2's pivoted (d_week_seq x d_day_name) composite
@@ -1980,7 +1989,10 @@ class JaxExecutor:
     # one-hot MXU segment sums stay exact while every |value| < 2^41
     # (ndstpu.ops.segsum bias bound) and rows fit the int32 accumulator
     _PALLAS_ROWS_MAX = (2 ** 31 - 1) // 255
-    _PALLAS_SEGS_MAX = 8192
+    # measured win margins: 69x at 1k segs, 5.8x at 18k, 1.85x at 65k
+    # (one-hot work grows with rows x segs); 32k keeps the whole
+    # SF1 item domain on the kernel with a comfortable margin
+    _PALLAS_SEGS_MAX = 32768
 
     def _pallas_sum_ok(self, c: DCol, ngseg: int) -> bool:
         if ngseg > self._PALLAS_SEGS_MAX or \
@@ -2014,8 +2026,15 @@ class JaxExecutor:
         c = evl.eval(a.arg)
         valid = c.valid & alive
         if use_pallas and func in ("sum", "avg") and \
-                self._pallas_sum_ok(c, ngseg):
-            # exact int64 sums + counts in one one-hot MXU kernel pass
+                self._pallas_sum_ok(c, ngseg) and \
+                (not self._pallas_interpret() or self._groupby_explicit):
+            # exact int64 sums + counts in one one-hot MXU kernel pass.
+            # Interpret-mode execution (eager/discovery, CPU platforms)
+            # keeps the scatter path unless pallas was requested
+            # explicitly: the Pallas INTERPRETER over a power-run-sized
+            # grid is drastically slower than XLA's scatter, and the
+            # path choice adds no size-plan sync points, so discovery-
+            # on-scatter + replay-on-kernel stays record-consistent.
             from ndstpu.ops import segsum
             sums, cnts = segsum.segment_sum_decimal(
                 c.data.astype(jnp.int64), gid, valid, ngseg,

@@ -61,22 +61,41 @@ def _f32_kernel(vals_ref, gid_ref, out_ref):
     # must be innermost — TPU Pallas only keeps an output block resident
     # across consecutive same-index grid steps, so accumulating across an
     # outer dim would revisit flushed blocks (wrong results on hardware).
+    #
+    # Formulated WITHOUT reshapes/transposes: collapsing the (sublane,
+    # lane) block into one vector dim is the "unsupported shape cast"
+    # Mosaic rejected.  Instead each sublane row r contributes a
+    # (1, LANES) x (segs, LANES) dot_general contracting the lane dim —
+    # a transposed one-hot product the MXU takes directly; the static
+    # python loop unrolls over the block's sublanes.
     j = pl.program_id(0)
     i = pl.program_id(1)
-    seg0 = j * out_ref.shape[1]
-    b = vals_ref.shape[0] * vals_ref.shape[1]
-    v = vals_ref[...].reshape(1, b)
-    g = gid_ref[...].reshape(b, 1)
-    seg = seg0 + jax.lax.broadcasted_iota(jnp.int32, (b, out_ref.shape[1]),
-                                          1)
-    onehot = (g == seg).astype(jnp.float32)
-    partial = jnp.dot(v, onehot, preferred_element_type=jnp.float32)
+    nseg = out_ref.shape[1]
+    # keep index math in int32: under jax_enable_x64 the python-int
+    # multiply promotes to int64 and the int64 (nseg, LANES) compare
+    # crashes the Mosaic vector-layout pass (the historical
+    # "unsupported shape cast" was the same class of failure)
+    seg0 = (j * nseg).astype(jnp.int32)
+    segs = seg0 + jax.lax.broadcasted_iota(jnp.int32, (nseg, _LANES), 0)
+    acc = jnp.zeros((1, nseg), jnp.float32)
+    for r in range(vals_ref.shape[0]):
+        g = gid_ref[r:r + 1, :]                       # (1, LANES)
+        v = vals_ref[r:r + 1, :]                      # (1, LANES)
+        onehot_t = (jnp.broadcast_to(g, (nseg, _LANES)) == segs
+                    ).astype(jnp.float32)             # (segs, LANES)
+        # HIGHEST: the MXU's default bf16 passes would round the VALUE
+        # operand (the 0/1 one-hot is bf16-exact; arbitrary f32 values
+        # are not — observed ~1e-3 relative drift at default precision)
+        acc = acc + jax.lax.dot_general(
+            v, onehot_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)      # (1, segs)
 
     @pl.when(i == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    out_ref[...] += partial
+    out_ref[...] += acc
 
 
 @functools.partial(jax.jit,
@@ -100,39 +119,54 @@ def segment_sum_f32(vals: jnp.ndarray, gid: jnp.ndarray,
     v2 = v.reshape(n // _LANES, _LANES)
     g2 = g.reshape(n // _LANES, _LANES)
     grid = (s_pad // block_segs, n // block_rows)
-    out = pl.pallas_call(
-        _f32_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
-            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_segs), lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((1, s_pad), jnp.float32),
-        interpret=interpret,
-    )(v2, g2)
+    # trace the kernel with x64 promotion OFF: under jax_enable_x64 the
+    # pallas machinery emits int64 grid/index scalars and the Mosaic
+    # vector-layout pass rejects the program (tpu_compile_helper exit 1
+    # with no diagnostics); all kernel inputs are explicitly 32-bit
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _f32_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
+                pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_segs), lambda j, i: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((1, s_pad), jnp.float32),
+            interpret=interpret,
+        )(v2, g2)
     return out[0, :num_segments]
 
 
 def _limb_kernel(limbs_ref, gid_ref, out_ref):
-    # same grid orientation as _f32_kernel: rows (reduction) innermost
+    # same grid orientation and reshape-free formulation as _f32_kernel:
+    # rows (reduction) innermost; per sublane row, all limb planes at
+    # once via one (nl, LANES) x (segs, LANES) lane-contracting
+    # dot_general
     j = pl.program_id(0)
     i = pl.program_id(1)
-    seg0 = j * out_ref.shape[1]
+    nseg = out_ref.shape[1]
+    seg0 = (j * nseg).astype(jnp.int32)  # int32: see _f32_kernel note
     nl = limbs_ref.shape[0]
-    b = limbs_ref.shape[1] * limbs_ref.shape[2]
-    g = gid_ref[...].reshape(b, 1)
-    seg = seg0 + jax.lax.broadcasted_iota(jnp.int32, (b, out_ref.shape[1]),
-                                          1)
-    onehot = (g == seg).astype(jnp.float32)
-    lv = limbs_ref[...].reshape(nl, b)
-    partial = jnp.dot(lv, onehot, preferred_element_type=jnp.float32)
+    segs = seg0 + jax.lax.broadcasted_iota(jnp.int32, (nseg, _LANES), 0)
+    acc = jnp.zeros((nl, nseg), jnp.float32)
+    for r in range(limbs_ref.shape[1]):
+        g = gid_ref[r:r + 1, :]                       # (1, LANES)
+        lv = limbs_ref[:, r, :]                       # (nl, LANES)
+        onehot_t = (jnp.broadcast_to(g, (nseg, _LANES)) == segs
+                    ).astype(jnp.float32)             # (segs, LANES)
+        # default MXU precision is EXACT here: 8-bit limbs (<=255) and
+        # the 0/1 one-hot are both bf16-representable, and the f32
+        # accumulator stays within its exact-integer range
+        acc = acc + jax.lax.dot_general(
+            lv, onehot_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (nl, segs)
 
     @pl.when(i == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    out_ref[...] += partial.astype(jnp.int32)
+    out_ref[...] += acc.astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
@@ -168,19 +202,22 @@ def segment_sum_decimal(vals: jnp.ndarray, gid: jnp.ndarray,
     lv = jnp.stack(limbs).reshape(_N_LIMBS + 1, n // _LANES, _LANES)
     g2 = g.reshape(n // _LANES, _LANES)
     grid = (s_pad // block_segs, n // block_rows)
-    out = pl.pallas_call(
-        _limb_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((_N_LIMBS + 1, rows, _LANES),
-                         lambda j, i: (0, i, 0)),
-            pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((_N_LIMBS + 1, block_segs),
-                               lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((_N_LIMBS + 1, s_pad), jnp.int32),
-        interpret=interpret,
-    )(lv, g2)
+    # x64 promotion off for the kernel trace — see segment_sum_f32
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            _limb_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((_N_LIMBS + 1, rows, _LANES),
+                             lambda j, i: (0, i, 0)),
+                pl.BlockSpec((rows, _LANES), lambda j, i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((_N_LIMBS + 1, block_segs),
+                                   lambda j, i: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((_N_LIMBS + 1, s_pad),
+                                           jnp.int32),
+            interpret=interpret,
+        )(lv, g2)
     out = out[:, :num_segments].astype(jnp.int64)
     counts = out[_N_LIMBS]
     sums = jnp.zeros(num_segments, jnp.int64)
