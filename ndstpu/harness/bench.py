@@ -180,6 +180,10 @@ def run_full_bench(yaml_params: dict) -> None:
             cmd += ["--json_summary_folder", p["json_summary_folder"]]
         if p.get("output_prefix"):
             cmd += ["--output_prefix", p["output_prefix"]]
+        if p.get("compile_records"):
+            # persisted size-plan records (+ the NDSTPU_XLA_CACHE_DIR
+            # persistent cache): accel engines skip per-query discovery
+            cmd += ["--compile_records", p["compile_records"]]
         run(cmd)
     power_elapse = float(get_power_time(p["report_file"])) / 1000
 
@@ -193,12 +197,15 @@ def run_full_bench(yaml_params: dict) -> None:
                 # device admission: at most N streams on the chip at a
                 # time (the concurrentGpuTasks analog)
                 tcmd += ["--concurrent", str(t["concurrent"])]
-            run(tcmd + ["--"] +
-                PY + ["ndstpu.harness.power",
-                      os.path.join(g["stream_output_path"], "query_{}.sql"),
-                      l["warehouse_path"],
-                      t["report_base"] + "_{}.csv",
-                      "--engine", p.get("engine", "cpu")])
+            pcmd = PY + ["ndstpu.harness.power",
+                         os.path.join(g["stream_output_path"],
+                                      "query_{}.sql"),
+                         l["warehouse_path"],
+                         t["report_base"] + "_{}.csv",
+                         "--engine", p.get("engine", "cpu")]
+            if p.get("compile_records"):
+                pcmd += ["--compile_records", p["compile_records"]]
+            run(tcmd + ["--"] + pcmd)
         ttt[fs] = get_throughput_time(t["report_base"], num_streams, fs)
         if not m.get("skip"):
             for i in get_stream_range(num_streams, fs):

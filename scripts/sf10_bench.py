@@ -1,0 +1,116 @@
+"""SF10 full-corpus power run + spot validation on the real chip.
+
+VERDICT r4 #1: convert "beats a numpy interpreter at SF1" into a scale
+claim.  Pipeline (expects .bench_cache/wh_sf10 to exist — bench.py's
+_ensure_warehouse or scripts in this round build + stamp it):
+
+1. full-corpus discover + steady pass at SF10 via scripts/warm_corpus.py
+   machinery (per-query watchdog; persisted records + XLA cache) —
+   writes .bench_cache/warm_report_sf10.json
+2. spot validation: N queries run through the power CLI on BOTH engines
+   (tpu vs numpy cpu) and compared by the validate CLI with reference
+   epsilon semantics
+3. assembles docs/SF10_BENCH.json: per-query discover/steady seconds,
+   steady totals, the SF10 Load Test time, and validation verdicts
+
+Usage:
+    python scripts/sf10_bench.py [--validate_queries q3,q7,...]
+    python scripts/sf10_bench.py --skip_corpus   # only validate+assemble
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CACHE = REPO / ".bench_cache"
+
+DEFAULT_VALIDATE = ("query3,query7,query15,query21,query26,query37,"
+                    "query42,query43,query52,query55,query82,query96")
+
+
+def run_corpus() -> None:
+    env = dict(os.environ, NDSTPU_BENCH_SF="10",
+               NDSTPU_WARM_QUERY_TIMEOUT_S=os.environ.get(
+                   "NDSTPU_WARM_QUERY_TIMEOUT_S", "2400"))
+    subprocess.run([sys.executable, str(REPO / "scripts" / "warm_corpus.py")],
+                   check=True, env=env, cwd=str(REPO))
+
+
+def run_validation(queries: str, out_dir: pathlib.Path) -> dict:
+    wh = str(CACHE / "wh_sf10")
+    streams = out_dir / "streams"
+    subprocess.run([sys.executable, "-m", "ndstpu.queries.streamgen",
+                    "--streams", "1", "--rngseed", "07291122510",
+                    "--output_dir", str(streams)],
+                   check=True, cwd=str(REPO))
+    stream = str(streams / "query_0.sql")
+    env = dict(os.environ,
+               NDSTPU_XLA_CACHE_DIR=str(CACHE / "xla_cache_tpu"))
+    for engine, prefix in (("tpu", "t"), ("cpu", "c")):
+        subprocess.run(
+            [sys.executable, "-m", "ndstpu.harness.power", stream, wh,
+             str(out_dir / f"time_{prefix}.csv"), "--engine", engine,
+             "--output_prefix", str(out_dir / prefix),
+             "--compile_records", str(CACHE / "plans_sf10.pkl"),
+             "--sub_queries", queries],
+            check=True, env=env, cwd=str(REPO))
+    r = subprocess.run(
+        [sys.executable, "-m", "ndstpu.harness.validate",
+         str(out_dir / "t"), str(out_dir / "c"), stream,
+         "--ignore_ordering", "--sub_queries", queries],
+        capture_output=True, text=True, cwd=str(REPO))
+    passed = [q for q in queries.split(",")
+              if f"Result match for {q} " in r.stdout]
+    return {"queries": queries.split(","), "passed": passed,
+            "all_match": "All queries match." in r.stdout,
+            "validate_exit": r.returncode}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validate_queries", default=DEFAULT_VALIDATE)
+    ap.add_argument("--skip_corpus", action="store_true")
+    ap.add_argument("--skip_validation", action="store_true")
+    args = ap.parse_args()
+    if not args.skip_corpus:
+        run_corpus()
+    report = {}
+    warm_path = CACHE / "warm_report_sf10.json"
+    if warm_path.exists():
+        warm = json.loads(warm_path.read_text())
+        steady = warm.get("steady", {})
+        report["per_query"] = {
+            q: {"discover_s": warm.get("discover", {}).get(q),
+                "steady_s": s}
+            for q, s in steady.items()}
+        report["queries_steady"] = len(steady)
+        report["steady_total_s"] = round(sum(steady.values()), 2)
+        report["failed"] = warm.get("failed", {})
+    try:
+        for line in open(CACHE / "wh_sf10_r5_load.txt"):
+            if "Load Test Time" in line:
+                report["load_test_s"] = float(line.split(":")[1].split()[0])
+    except OSError:
+        pass
+    if not args.skip_validation:
+        vdir = pathlib.Path("/tmp/sf10_validate")
+        import shutil
+        shutil.rmtree(vdir, ignore_errors=True)
+        vdir.mkdir(parents=True)
+        report["validation"] = run_validation(args.validate_queries, vdir)
+    out = REPO / "docs" / "SF10_BENCH.json"
+    out.write_text(json.dumps(report, indent=1))
+    print(json.dumps({k: v for k, v in report.items()
+                      if k != "per_query"}, indent=1))
+    print(f"written: {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
