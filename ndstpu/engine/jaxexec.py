@@ -3429,8 +3429,31 @@ class CompilingExecutor(JaxExecutor):
             if ok:
                 data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta,
                              cp.seg_fps, cp.out_capacity)
-        with open(path, "wb") as f:
+        # MERGE with what's already on disk, then publish atomically:
+        # a subset run (e.g. a 12-query validation pass) must never
+        # truncate a full-corpus record file another process spent
+        # hours warming, and concurrent throughput streams saving to
+        # one path must never interleave writes into a corrupt pickle
+        # (last atomic writer wins with a valid superset).
+        try:
+            with open(path, "rb") as f:
+                prev = pickle.load(f)
+            if isinstance(prev, dict) and \
+                    prev.get("\x00fmt") == self._REC_FORMAT:
+                for k, v in prev.items():
+                    if k == "\x00segments":
+                        for fp, sv in v.items():
+                            segstore.setdefault(fp, sv)
+                    else:
+                        data.setdefault(k, v)
+        except Exception:  # noqa: BLE001 — absent or corrupt prior file
+            pass
+        import os as _os
+        import uuid as _uuid
+        tmp = f"{path}.tmp.{_uuid.uuid4().hex}"
+        with open(tmp, "wb") as f:
             pickle.dump(data, f)
+        _os.replace(tmp, path)
         return len(data) - 2
 
     def load_compile_records(self, path: str, plan_for_key,

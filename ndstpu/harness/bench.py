@@ -182,8 +182,14 @@ def run_full_bench(yaml_params: dict) -> None:
             cmd += ["--output_prefix", p["output_prefix"]]
         if p.get("compile_records"):
             # persisted size-plan records (+ the NDSTPU_XLA_CACHE_DIR
-            # persistent cache): accel engines skip per-query discovery
-            cmd += ["--compile_records", p["compile_records"]]
+            # persistent cache): accel engines skip per-query discovery.
+            # Absolutized so subprocess cwd can't silently miss it.
+            rec = os.path.abspath(p["compile_records"])
+            p["compile_records"] = rec
+            if not os.path.exists(rec):
+                print(f"WARNING: compile_records {rec} does not exist "
+                      f"yet — accel power runs will pay full discovery")
+            cmd += ["--compile_records", rec]
         run(cmd)
     power_elapse = float(get_power_time(p["report_file"])) / 1000
 

@@ -602,3 +602,24 @@ def test_pivot_keyless_count_on_empty_input(cpu_sess, tpu_sess):
     got = tpu_sess.sql(sql).to_rows()
     assert want == [(None, None, None, 0)]
     assert _rows_equal(got, want)
+
+
+def test_compile_records_merge_not_truncate(catalog, tmp_path):
+    """A subset session saving records must MERGE with the on-disk file
+    (a 12-query validation run must never truncate a full-corpus warm),
+    and the write must be atomic."""
+    rec = str(tmp_path / "plans.pkl")
+    s1 = Session(catalog, backend="tpu")
+    s1.sql("select ss_store_sk, sum(ss_quantity) q from store_sales "
+           "group by ss_store_sk").to_rows()
+    s1.sql("select i_category, count(*) n from item "
+           "group by i_category").to_rows()
+    n1 = s1.save_compiled(rec)
+    assert n1 >= 2
+    s2 = Session(catalog, backend="tpu")
+    s2.sql("select d_year, count(*) n from date_dim "
+           "group by d_year").to_rows()
+    n2 = s2.save_compiled(rec)
+    assert n2 >= n1 + 1, "merge lost prior records"
+    s3 = Session(catalog, backend="tpu")
+    assert s3.preload_compiled(rec) >= n1 + 1
