@@ -73,3 +73,39 @@ for phase in ("discover", "steady"):
     print(f"== {phase} total {tot:.1f}s over {len(report[phase])} queries ==", flush=True)
 with open(REPO / ".bench_cache" / f"warm_report_sf{SF}.json", "w") as f:
     json.dump(report, f, indent=1)
+
+# phase 3 (opt-out: NDSTPU_WARM_RECHECK=0): replay the corpus once in a
+# FRESH subprocess.  Segment-bearing queries compile a slightly
+# different program variant from preloaded records than from the
+# in-discovery warm context (same HLO text, different XLA cache key —
+# root cause still open, docs/STATUS.md); the fresh pass pays each
+# variant once and seeds the persistent cache so every later process
+# (the power CLI, bench.py run 1) goes straight to compiled replay.
+if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
+    import subprocess
+    code = (
+        "import sys, time, json, os; sys.path.insert(0, %r);\n"
+        "import jax;\n"
+        "jax.config.update('jax_compilation_cache_dir', %r);\n"
+        "jax.config.update('jax_persistent_cache_min_compile_time_secs', 2.0);\n"
+        "from ndstpu.engine.session import Session;\n"
+        "from ndstpu.io import loader;\n"
+        "from ndstpu.queries import streamgen;\n"
+        "cat = loader.load_catalog(%r);\n"
+        "s = Session(cat, backend='tpu');\n"
+        "print('recheck preloaded', s.preload_compiled(%r), flush=True)\n"
+        "qs = []\n"
+        "for tpl in streamgen.list_templates():\n"
+        "    qs.extend(streamgen.render_template_parts(\n"
+        "        str(streamgen.TEMPLATE_DIR / tpl), '07291122510', 0))\n"
+        "for name, sql in qs:\n"
+        "    t0 = time.time()\n"
+        "    try:\n"
+        "        s.sql(sql).to_rows()\n"
+        "        print(f'recheck {name}: {time.time()-t0:.2f}s', flush=True)\n"
+        "    except Exception as e:\n"
+        "        print(f'recheck {name}: ERR {e}', flush=True)\n"
+    ) % (str(REPO), str(REPO / ".bench_cache" / "xla_cache_tpu"),
+         str(REPO / ".bench_cache" / f"wh_sf{SF}"), rec)
+    print("== recheck phase (fresh subprocess) ==", flush=True)
+    subprocess.run([sys.executable, "-c", code], cwd=str(REPO))
