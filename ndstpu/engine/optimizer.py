@@ -685,6 +685,261 @@ def pivot_case_aggregates(p: lp.Plan) -> lp.Plan:
     return p
 
 
+def _unwrap_renames(p: lp.Plan):
+    """Peel pure-rename Projects; return (inner plan, outer->inner name
+    map composed across the chain, or None if no Project was peeled —
+    the caller treats None as identity)."""
+    mapping: Optional[Dict[str, str]] = None
+    while isinstance(p, lp.Project) and \
+            all(isinstance(e, ex.ColumnRef) for _n, e in p.exprs):
+        layer = {n: e.name for n, e in p.exprs}
+        if mapping is None:
+            mapping = layer
+        else:
+            mapping = {n: layer[v] for n, v in mapping.items()
+                       if v in layer}
+        p = p.child
+    return p, mapping
+
+
+@dataclasses.dataclass
+class _ScalarAggLeaf:
+    table: str
+    alias: str
+    columns: Optional[List[str]]
+    conjs: List[ex.Expr]            # scan-native names
+    # visible output name -> (func, distinct, native arg column)
+    outputs: List[Tuple[str, str, bool, str]]
+
+
+def _match_scalar_agg_leaf(leaf: lp.Plan) -> Optional[_ScalarAggLeaf]:
+    agg, out_map = _unwrap_renames(leaf)
+    if not (isinstance(agg, lp.Aggregate) and not agg.group_by
+            and agg.grouping_sets is None and agg.aggs):
+        return None
+    src, in_map = _unwrap_renames(agg.child)
+    if not isinstance(src, lp.Scan):
+        return None
+    agg_names = {n for n, _e in agg.aggs}
+    if out_map is None:
+        out_map = {n: n for n in agg_names}
+    if in_map is None:
+        in_map = {}
+        for _n, e in agg.aggs:
+            if isinstance(e, ex.AggExpr) and \
+                    isinstance(e.arg, ex.ColumnRef):
+                in_map[e.arg.name] = e.arg.name
+    if set(out_map.values()) != agg_names or \
+            len(out_map) != len(agg_names):
+        return None  # rename chain must be a bijection onto the aggs
+    by_name = dict(agg.aggs)
+    outputs: List[Tuple[str, str, bool, str]] = []
+    for vis, internal in out_map.items():
+        e = by_name[internal]
+        if not (isinstance(e, ex.AggExpr) and
+                e.func in ("sum", "count", "min", "max", "avg")):
+            return None
+        if e.distinct and e.func in ("min", "max"):
+            return None
+        if isinstance(e.arg, ex.Star):
+            if e.func != "count" or e.distinct:
+                return None
+            native = "*"
+        elif isinstance(e.arg, ex.ColumnRef):
+            native = in_map.get(e.arg.name)
+            if native is None:
+                return None
+        else:
+            return None
+        outputs.append((vis, e.func, e.distinct, native))
+    return _ScalarAggLeaf(src.table, src.alias, src.columns,
+                          _conjuncts(src.predicate), outputs)
+
+
+def _interval_of(conjs: List[ex.Expr]):
+    """Parse conjuncts as one closed interval on one column; returns
+    (column name, lo, hi) or None.  Only >=/<=/= against numeric
+    literals — the disjointness proof needs exact endpoint arithmetic."""
+    col, lo, hi = None, None, None
+    for c in conjs:
+        if not (isinstance(c, ex.BinOp) and
+                isinstance(c.left, ex.ColumnRef) and
+                isinstance(c.right, ex.Literal) and
+                isinstance(c.right.value, (int, float)) and
+                not isinstance(c.right.value, bool) and
+                c.op in (">=", "<=", "=")):
+            return None
+        if col is None:
+            col = c.left.name
+        elif col != c.left.name:
+            return None
+        v = c.right.value
+        if c.op in (">=", "="):
+            lo = v if lo is None else max(lo, v)
+        if c.op in ("<=", "="):
+            hi = v if hi is None else min(hi, v)
+    if col is None or lo is None or hi is None or lo > hi:
+        return None
+    return col, lo, hi
+
+
+def fuse_sibling_scalar_aggregates(
+        p: lp.Plan, _used: Optional[Set[str]] = None) -> lp.Plan:
+    """Fuse N cross-joined keyless aggregates over the SAME table whose
+    filters differ only by pairwise-disjoint intervals on one column
+    into ONE grouped aggregation.
+
+    The q28 idiom: six scalar-subquery scans of store_sales, each
+    keeping a disjoint ``ss_quantity`` bucket plus a shared OR filter,
+    each computing avg/count/count-distinct over the full fact spine —
+    six passes (and six presence-bitmap distinct reductions) where one
+    suffices.  Rewrite: one scan filtered to the union of buckets, a
+    CASE bucket id, ONE Aggregate grouped by bucket (count-distinct
+    rides the grouped presence-bitmap path), then a keyless extraction
+    aggregate pulling each branch's scalars out of its bucket row.
+
+    Soundness: the intervals are proven pairwise disjoint on literal
+    endpoints, so every row lands in at most one bucket — each bucket
+    group sees exactly the rows its original branch scanned.  A branch
+    with no surviving rows has no bucket row: the extraction
+    ``max(case when bucket=i ...)`` over zero matches is NULL, matching
+    the scalar aggregate's NULL (counts coalesce to 0, matching
+    count-over-nothing).  Mirrors the reference's q28 single-pass GPU
+    plan shape (rapids combines the branches into one kernel sweep)."""
+    if _used is None:
+        _used = set()
+
+    def is_cross(n: lp.Plan) -> bool:
+        return isinstance(n, lp.Join) and n.kind == "cross" and \
+            not n.keys and n.extra is None and n.mark is None
+
+    if not is_cross(p):
+        for f in dataclasses.fields(p):
+            v = getattr(p, f.name)
+            if isinstance(v, lp.Plan):
+                setattr(p, f.name,
+                        fuse_sibling_scalar_aggregates(v, _used))
+        return p
+
+    # flatten the WHOLE cross-join spine before matching — recursing
+    # join-child-first would fuse the innermost pair and hide the rest
+    # of the siblings from the 6-way q28 match
+    leaves: List[lp.Plan] = []
+
+    def flatten(n: lp.Plan):
+        if is_cross(n):
+            flatten(n.left)
+            flatten(n.right)
+        else:
+            leaves.append(n)
+
+    flatten(p)
+    leaves = [fuse_sibling_scalar_aggregates(l, _used) for l in leaves]
+
+    def rebuild(parts: List[lp.Plan]) -> lp.Plan:
+        out = parts[0]
+        for nxt in parts[1:]:
+            out = lp.Join(out, nxt, "cross", [])
+        return out
+    matched = [(i, m) for i, leaf in enumerate(leaves)
+               if (m := _match_scalar_agg_leaf(leaf)) is not None]
+    # fuse EVERY qualifying table group (groups are over disjoint leaf
+    # sets, so the rewrites compose)
+    by_table: Dict[str, List[Tuple[int, _ScalarAggLeaf]]] = {}
+    for i, m in matched:
+        by_table.setdefault(m.table, []).append((i, m))
+    fused_nodes: List[lp.Plan] = []
+    fused_idx: Set[int] = set()
+    for group in by_table.values():
+        if len(group) < 2:
+            continue
+        # shared conjuncts: structurally present in EVERY branch
+        shared = [c for c in group[0][1].conjs
+                  if all(any(c == d for d in m.conjs)
+                         for _i, m in group[1:])]
+        ivals = []
+        ok = True
+        for _i, m in group:
+            spec = [c for c in m.conjs if all(c != s for s in shared)]
+            iv = _interval_of(spec)
+            if iv is None:
+                ok = False
+                break
+            ivals.append((iv, spec))
+        if not ok or len({iv[0] for iv, _s in ivals}) != 1:
+            continue
+        spans = sorted((lo, hi) for (_c, lo, hi), _s in ivals)
+        if any(a[1] >= b[0] for a, b in zip(spans, spans[1:])):
+            continue  # overlapping buckets: rows could belong to two
+        fused_nodes.append(_build_fused(group, shared, ivals, _used))
+        fused_idx |= {i for i, _m in group}
+    if not fused_nodes:
+        return rebuild(leaves)
+    rest = [leaf for i, leaf in enumerate(leaves) if i not in fused_idx]
+    return rebuild(fused_nodes + rest)
+
+
+def _build_fused(group, shared, ivals, used: Set[str]) -> lp.Plan:
+    """Materialize one fused subtree for a qualifying sibling group."""
+    # generated names must be a pure function of the plan: persisted
+    # compile records and the XLA persistent cache key on plan
+    # fingerprints, so a process-varying counter here would make every
+    # replan recompile.  Content-hash the fused group; uniquify
+    # deterministically (traversal order is a function of the plan too).
+    import hashlib
+    m0 = group[0][1]
+    desc = repr((m0.table,
+                 [[repr(c) for c in m.conjs] for _i, m in group],
+                 [m.outputs for _i, m in group]))
+    tag = "__ssa" + hashlib.md5(desc.encode()).hexdigest()[:8]
+    while tag in used:
+        tag += "x"
+    used.add(tag)
+    bucket = f"{tag}_b"
+    cols = None if any(m.columns is None for _i, m in group) else \
+        sorted({c for _i, m in group for c in m.columns})
+    branch_conds = [_conjoin(spec) for _iv, spec in ivals]
+    union = branch_conds[0]
+    for c in branch_conds[1:]:
+        union = ex.BinOp("or", union, c)
+    scan = lp.Scan(m0.table, m0.alias, cols,
+                   _conjoin(list(shared) + [union]))
+    # one level-1 agg per distinct (func, distinct, native arg)
+    l1_key: Dict[Tuple[str, bool, str], str] = {}
+    l1_aggs: List[Tuple[str, ex.Expr]] = []
+    need_cols = set()
+    for _i, m in group:
+        for _vis, func, dist, native in m.outputs:
+            k = (func, dist, native)
+            if k not in l1_key:
+                l1_key[k] = f"{tag}_a{len(l1_key)}"
+                arg = ex.Star() if native == "*" else \
+                    ex.ColumnRef(native)
+                l1_aggs.append(
+                    (l1_key[k], ex.AggExpr(func, arg, dist)))
+            if native != "*":
+                need_cols.add(native)
+    proj = lp.Project(scan, [(bucket, ex.Case(
+        tuple((cond, ex.Literal(j, None))
+              for j, cond in enumerate(branch_conds)),
+        ex.Literal(None, None)))] +
+        [(c, ex.ColumnRef(c)) for c in sorted(need_cols)])
+    l1 = lp.Aggregate(proj, [(bucket, ex.ColumnRef(bucket))],
+                      l1_aggs, None)
+    l2_aggs: List[Tuple[str, ex.Expr]] = []
+    for j, (_i, m) in enumerate(group):
+        for vis, func, dist, native in m.outputs:
+            pick = ex.AggExpr("max", ex.Case(
+                ((ex.BinOp("=", ex.ColumnRef(bucket),
+                           ex.Literal(j, None)),
+                  ex.ColumnRef(l1_key[(func, dist, native)])),),
+                ex.Literal(None, None)))
+            if func == "count":
+                pick = ex.Func("coalesce", (pick, ex.Literal(0, None)))
+            l2_aggs.append((vis, pick))
+    return lp.Aggregate(l1, [], l2_aggs, None)
+
+
 def _optimize_embedded(p: lp.Plan, catalog) -> None:
     """Optimize plans embedded in SubqueryExpr leaves (uncorrelated scalar /
     IN subqueries survive planning as expressions — without this their join
@@ -701,6 +956,7 @@ def optimize(p: lp.Plan, catalog=None) -> lp.Plan:
     p = push_filters(p)
     p = reorder_joins(p, catalog)
     p = pivot_case_aggregates(p)
+    p = fuse_sibling_scalar_aggregates(p)
     p = null_filter_to_anti(p)
     p = prune(p, None)
     _optimize_embedded(p, catalog)

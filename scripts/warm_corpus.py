@@ -83,6 +83,22 @@ with open(REPO / ".bench_cache" / f"warm_report_sf{SF}.json", "w") as f:
 # (the power CLI, bench.py run 1) goes straight to compiled replay.
 if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
     import subprocess
+    # skip queries the discover/steady watchdog recorded as hung — the
+    # child has no per-query watchdog, so replaying a wedged compile
+    # would block this script (and sf10_bench.py above it) forever;
+    # honor the same `only` CLI filter the first two phases use
+    skip = set(report["failed"])
+    # hand the child the SAME (name, sql) list this process warmed —
+    # re-rendering in the child could silently diverge from the
+    # parent's corpus (seed, render args) and warm the wrong queries
+    replay = [(name, sql) for name, sql in queries
+              if name not in skip and (not only or name in only)]
+    if not replay:
+        print("== recheck phase: nothing to replay ==", flush=True)
+        raise SystemExit(0)
+    qfile = REPO / ".bench_cache" / f"recheck_sf{SF}.json"
+    with open(qfile, "w") as f:
+        json.dump(replay, f)
     code = (
         "import sys, time, json, os; sys.path.insert(0, %r);\n"
         "import jax;\n"
@@ -90,14 +106,10 @@ if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
         "jax.config.update('jax_persistent_cache_min_compile_time_secs', 2.0);\n"
         "from ndstpu.engine.session import Session;\n"
         "from ndstpu.io import loader;\n"
-        "from ndstpu.queries import streamgen;\n"
         "cat = loader.load_catalog(%r);\n"
         "s = Session(cat, backend='tpu');\n"
         "print('recheck preloaded', s.preload_compiled(%r), flush=True)\n"
-        "qs = []\n"
-        "for tpl in streamgen.list_templates():\n"
-        "    qs.extend(streamgen.render_template_parts(\n"
-        "        str(streamgen.TEMPLATE_DIR / tpl), '07291122510', 0))\n"
+        "qs = json.load(open(%r))\n"
         "for name, sql in qs:\n"
         "    t0 = time.time()\n"
         "    try:\n"
@@ -106,6 +118,16 @@ if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
         "    except Exception as e:\n"
         "        print(f'recheck {name}: ERR {e}', flush=True)\n"
     ) % (str(REPO), str(REPO / ".bench_cache" / "xla_cache_tpu"),
-         str(REPO / ".bench_cache" / f"wh_sf{SF}"), rec)
+         str(REPO / ".bench_cache" / f"wh_sf{SF}"), rec, str(qfile))
     print("== recheck phase (fresh subprocess) ==", flush=True)
-    subprocess.run([sys.executable, "-c", code], cwd=str(REPO))
+    # a whole-corpus ceiling keeps a wedged variant compile from
+    # hanging the orchestration that invoked us; scale with the number
+    # of queries actually replayed (most replay in seconds, a variant
+    # compile costs ~20-95s)
+    n = max(1, len(replay))
+    try:
+        subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                       timeout=PER_Q * max(4.0, 0.25 * n))
+    except subprocess.TimeoutExpired:
+        print("== recheck phase timed out; persistent cache keeps "
+              "whatever compiled ==", flush=True)

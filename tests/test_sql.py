@@ -306,3 +306,25 @@ def test_q3_full_text(sess):
     for i in range(1, t.num_rows):
         if d["d_year"][i] == d["d_year"][i - 1]:
             assert d["sum_agg"][i] <= d["sum_agg"][i - 1] + 1e-9
+
+
+def test_sibling_fusion_two_table_groups(sess):
+    """Every qualifying sibling group fuses — two groups over two
+    different tables in one cross spine collapse to two scans, with
+    hand-computed scalars (sales qty: 10,20,30,40,50,60 on rows whose
+    price is 1.50,2.25,1.00,NULL,3.10,4.00; item_sk 1..3)."""
+    sql = ("select * from "
+           "(select count(price) c1, sum(qty) s1 from sales "
+           " where qty >= 0 and qty <= 25) a1, "
+           "(select count(price) c2, sum(qty) s2 from sales "
+           " where qty >= 26 and qty <= 100) a2, "
+           "(select count(*) c3 from item "
+           " where i_item_sk >= 1 and i_item_sk <= 1) b1, "
+           "(select count(*) c4 from item "
+           " where i_item_sk >= 2 and i_item_sk <= 3) b2")
+    from ndstpu.engine import plan as lp
+    p, _cols = sess.plan(sql)
+    scans = [n for n in p.walk() if isinstance(n, lp.Scan)]
+    assert len(scans) == 2, "each table group must fuse to one scan"
+    t = sess.sql(sql)
+    assert t.to_rows() == [(2, 30, 3, 180, 1, 2)]
