@@ -722,7 +722,10 @@ def _match_scalar_agg_leaf(leaf: lp.Plan) -> Optional[_ScalarAggLeaf]:
         return None
     agg_names = {n for n, _e in agg.aggs}
     if out_map is None:
-        out_map = {n: n for n in agg_names}
+        # declaration order, NOT set order — outputs feed the content
+        # hash that names the fused columns, which must be a pure
+        # function of the plan (set iteration varies per process)
+        out_map = {n: n for n, _e in agg.aggs}
     if in_map is None:
         in_map = {}
         for _n, e in agg.aggs:

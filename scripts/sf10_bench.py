@@ -92,12 +92,17 @@ def main() -> int:
         report["queries_steady"] = len(steady)
         report["steady_total_s"] = round(sum(steady.values()), 2)
         report["failed"] = warm.get("failed", {})
-    try:
-        for line in open(CACHE / "wh_sf10_r5_load.txt"):
-            if "Load Test Time" in line:
-                report["load_test_s"] = float(line.split(":")[1].split()[0])
-    except OSError:
-        pass
+    for cand in (CACHE / "wh_sf10" / "load.txt",
+                 CACHE / "wh_sf10_r5_load.txt"):
+        try:
+            for line in open(cand):
+                if "Load Test Time" in line:
+                    report["load_test_s"] = float(
+                        line.split(":")[1].split()[0])
+            if "load_test_s" in report:
+                break
+        except OSError:
+            continue
     if not args.skip_validation:
         vdir = pathlib.Path("/tmp/sf10_validate")
         import shutil

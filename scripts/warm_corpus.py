@@ -34,6 +34,26 @@ for tpl in streamgen.list_templates():
     queries.extend(streamgen.render_template_parts(
         str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
 
+# cheap-first ordering (NDSTPU_WARM_ORDER=<warm_report.json>): under a
+# deadline, warming in ascending known cost covers the most queries
+# before the q4/q11/q14/q67 compile monsters; unknown queries keep
+# corpus order, after the known-cheap ones
+_order = os.environ.get("NDSTPU_WARM_ORDER")
+if _order:
+    try:
+        _known = json.load(open(_order)).get("discover", {})
+        queries.sort(key=lambda q: (_known.get(q[0]) is None,
+                                    _known.get(q[0], 0.0)))
+        print(f"ordered by {_order}", flush=True)
+    except Exception as e:
+        print(f"order file unusable ({e}); corpus order", flush=True)
+
+# overall deadline (NDSTPU_WARM_DEADLINE_S, wall seconds from start):
+# when exceeded, remaining discover/steady work is skipped — partial
+# warm reports and caches are still written and valid
+_DEADLINE = time.time() + float(
+    os.environ.get("NDSTPU_WARM_DEADLINE_S", "1e12"))
+
 def run_one(sess, sql, slot):
     try:
         out = sess.sql(sql)
@@ -46,6 +66,9 @@ report = {"discover": {}, "steady": {}, "failed": {}}
 only = set(sys.argv[1:])
 for phase in ("discover", "steady"):
     for name, sql in queries:
+        if time.time() > _DEADLINE:
+            print(f"== deadline hit in {phase}; stopping ==", flush=True)
+            break
         if only and name not in only: continue
         if name in report["failed"]: continue
         slot = {}
@@ -91,8 +114,12 @@ if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
     # hand the child the SAME (name, sql) list this process warmed —
     # re-rendering in the child could silently diverge from the
     # parent's corpus (seed, render args) and warm the wrong queries
+    # only queries that completed discovery: recheck re-pays program
+    # VARIANTS of warmed queries — a deadline-cut query would pay its
+    # whole cold compile here, without the parent's watchdog
     replay = [(name, sql) for name, sql in queries
-              if name not in skip and (not only or name in only)]
+              if name in report["discover"] and name not in skip
+              and (not only or name in only)]
     if not replay:
         print("== recheck phase: nothing to replay ==", flush=True)
         raise SystemExit(0)
@@ -125,9 +152,11 @@ if os.environ.get("NDSTPU_WARM_RECHECK", "1") != "0":
     # of queries actually replayed (most replay in seconds, a variant
     # compile costs ~20-95s)
     n = max(1, len(replay))
+    ceiling = float(os.environ.get("NDSTPU_WARM_RECHECK_TIMEOUT_S",
+                                   "7200"))
     try:
         subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
-                       timeout=PER_Q * max(4.0, 0.25 * n))
+                       timeout=min(PER_Q * max(4.0, 0.25 * n), ceiling))
     except subprocess.TimeoutExpired:
         print("== recheck phase timed out; persistent cache keeps "
               "whatever compiled ==", flush=True)
