@@ -561,11 +561,16 @@ class JEval:
         if isinstance(value, bool):
             return DCol(jnp.full(cap, value, jnp.bool_), valid, BOOL)
         if isinstance(value, int):
+            # point bounds: every valid row is exactly this value —
+            # lets Case-of-literals keys (the fusion pass's bucket id)
+            # stay on the small-domain group-by/bitmap paths
             ct = ctype or (INT64 if abs(value) > 2 ** 31 - 1 else INT32)
             if ct.kind == "decimal":
-                return DCol(jnp.full(cap, value * 10 ** ct.scale, jnp.int64),
-                            valid, ct)
-            return DCol(jnp.full(cap, value, jnp_dtype(ct)), valid, ct)
+                v = value * 10 ** ct.scale
+                return DCol(jnp.full(cap, v, jnp.int64),
+                            valid, ct, bounds=(v, v))
+            return DCol(jnp.full(cap, value, jnp_dtype(ct)), valid, ct,
+                        bounds=(int(value), int(value)))
         if isinstance(value, float):
             if ctype and ctype.kind == "decimal":
                 return DCol(jnp.full(
@@ -832,17 +837,32 @@ class JEval:
         data = jnp.zeros(self.cap, jnp_dtype(tgt))
         valid = jnp.zeros(self.cap, bool)
         taken = jnp.zeros(self.cap, bool)
+        branch_bounds = []
         for cond, val in zip(conds, vals):
             vc = self.cast(val, tgt)
             sel = cond & ~taken
             data = jnp.where(sel, vc.data, data)
             valid = jnp.where(sel, vc.valid, valid)
             taken = taken | cond
+            branch_bounds.append(vc.bounds)
         if default is not None:
             dc = self.cast(default, tgt)
             data = jnp.where(taken, data, dc.data)
             valid = jnp.where(taken, valid, dc.valid)
-        return DCol(data.astype(jnp_dtype(tgt)), valid, tgt)
+            # a NULL-literal default contributes no VALID rows, so it
+            # cannot widen the bounds of the output's valid values
+            if not (isinstance(e.default, ex.Literal)
+                    and e.default.value is None):
+                branch_bounds.append(dc.bounds)
+        bounds = None
+        if tgt.kind in ("int32", "int64", "decimal") and branch_bounds \
+                and all(b is not None for b in branch_bounds):
+            # every valid output row carries some branch's valid value,
+            # so the union of branch bounds bounds the output
+            bounds = (min(b[0] for b in branch_bounds),
+                      max(b[1] for b in branch_bounds))
+        return DCol(data.astype(jnp_dtype(tgt)), valid, tgt,
+                    bounds=bounds)
 
     def _in_list(self, e: ex.InList) -> DCol:
         c = self.eval(e.operand)
