@@ -391,10 +391,7 @@ def main() -> None:
     from ndstpu.io import loader
     from ndstpu.queries import streamgen
 
-    queries = []
-    for tpl in streamgen.list_templates():
-        queries.extend(streamgen.render_template_parts(
-            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+    queries = streamgen.render_power_corpus()
     STATE["n_queries"] = len(queries)
 
     STATE["phase"] = "load-catalog"

@@ -103,6 +103,26 @@ def list_templates(template_dir: Optional[str] = None) -> List[str]:
                   key=lambda n: int(re.findall(r"\d+", n)[0]))
 
 
+#: the stream-0 seed every benchmark script renders with; keeping it in
+#: one place means warm caches, CPU baselines, and TPU passes can only
+#: ever compare timings of IDENTICAL rendered SQL
+BENCH_RNGSEED = "07291122510"
+
+
+def render_power_corpus(rngseed: str = BENCH_RNGSEED,
+                        stream: int = 0) -> List[Tuple[str, str]]:
+    """The canonical (query_name, sql) power-run corpus: every template,
+    split into executable parts, rendered with ``rngseed``.  Shared by
+    bench.py, warm_corpus, sf10_bench — per-script render loops drifted
+    once (different seed -> same names, different literals -> silently
+    wrong speedups)."""
+    queries: List[Tuple[str, str]] = []
+    for tpl in list_templates():
+        queries.extend(render_template_parts(
+            str(TEMPLATE_DIR / tpl), rngseed, stream))
+    return queries
+
+
 def _parse_template(text: str) -> Tuple[Dict[str, tuple], str]:
     params: Dict[str, tuple] = {}
     body_lines = []

@@ -109,10 +109,7 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001
         print(f"no compile records: {e}")
 
-    queries = []
-    for tpl in streamgen.list_templates():
-        queries.extend(streamgen.render_template_parts(
-            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+    queries = streamgen.render_power_corpus()
     if args.queries:
         want = set(args.queries.split(","))
         queries = [(n, s) for n, s in queries if n in want]

@@ -1,0 +1,103 @@
+"""Five-phase NDS benchmark on the real chip at SF1 + artifact capture.
+
+Runs the full orchestrator (ndstpu/harness/bench.py) from
+bench_hw_sf1.yml, then snapshots the phase reports into
+docs/HW_BENCH_SF1.json so the metric run is reviewable from the repo
+(the raw run dir lives in /tmp and does not survive the machine).
+
+Execution strategy note (recorded in the artifact): every stream in
+this run carries FRESH parameter draws (RNGSEED chains from the load
+end timestamp, spec 4.3.1), so no persisted compile record can match.
+One-shot queries therefore run the engine's eager discovery path
+(NDSTPU_WARM_REPLAY=0): paying a 20-95 s XLA compile per query would
+never amortize inside a single execution.  Repeated-stream workloads
+(the driver's bench.py power run) replay compiled programs instead.
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+RUN = pathlib.Path("/tmp/nds_hw")
+
+
+def _read_csv(path: pathlib.Path):
+    try:
+        with open(path) as f:
+            return list(csv.reader(f))
+    except OSError:
+        return None
+
+
+def main() -> int:
+    t0 = time.time()
+    env = dict(os.environ,
+               NDSTPU_WARM_REPLAY="0",
+               NDSTPU_XLA_CACHE_DIR=str(
+                   REPO / ".bench_cache" / "xla_cache_tpu"))
+    cfg = REPO / "ndstpu" / "harness" / "bench_hw_sf1.yml"
+    r = subprocess.run(
+        [sys.executable, "-m", "ndstpu.harness.bench", str(cfg)],
+        env=env, cwd=str(REPO))
+    art: dict = {
+        "config": str(cfg.relative_to(REPO)),
+        "exit_code": r.returncode,
+        "wall_s": round(time.time() - t0, 1),
+        "execution_strategy": (
+            "fresh parameter draws per stream (RNGSEED from load end "
+            "timestamp, spec 4.3.1) -> no compile-record reuse; "
+            "one-shot queries use eager discovery "
+            "(NDSTPU_WARM_REPLAY=0) because a per-query XLA compile "
+            "cannot amortize in a single execution"),
+    }
+    metrics = _read_csv(RUN / "metrics.csv")
+    if metrics:
+        art["metrics"] = {row[0]: row[1] for row in metrics if len(row) == 2}
+    for line in (RUN / "load_report.txt").read_text().splitlines() \
+            if (RUN / "load_report.txt").exists() else []:
+        if "Load Test Time" in line or "RNGSEED" in line:
+            art.setdefault("load_report", []).append(line.strip())
+    power = _read_csv(RUN / "power_time.csv")
+    if power:
+        art["power_per_query_s"] = {
+            row[1]: round(float(row[2]) / 1000, 3)
+            for row in power
+            if len(row) >= 3 and row[1].startswith("query")}
+        art["power_queries"] = len(art["power_per_query_s"])
+    for fs, streams in (("tt1", (1, 2)), ("tt2", (3, 4))):
+        tot = {}
+        for i in streams:
+            rows = _read_csv(RUN / f"tt_time_{i}.csv")
+            if rows:
+                tot[f"stream_{i}_queries"] = sum(
+                    1 for row in rows
+                    if len(row) >= 3 and row[1].startswith("query"))
+        if tot:
+            art[fs] = tot
+    for i in (1, 2, 3, 4):
+        rows = _read_csv(RUN / f"dm_time_{i}.csv")
+        if rows:
+            # rows: (app_id, LF_*/DF_* function, milliseconds); trailer
+            # rows carry start/end/elapsed in seconds
+            art.setdefault("maintenance", {})[f"stream_{i}"] = {
+                row[1]: round(float(row[2]) / 1000, 3)
+                for row in rows
+                if len(row) >= 3 and (row[1].startswith("LF_")
+                                      or row[1].startswith("DF_"))}
+    out = REPO / "docs" / "HW_BENCH_SF1.json"
+    out.write_text(json.dumps(art, indent=1))
+    print(json.dumps({k: v for k, v in art.items()
+                      if k not in ("power_per_query_s", "maintenance")},
+                     indent=1))
+    print(f"written: {out}")
+    return r.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,11 +42,101 @@ def run_corpus() -> None:
                    check=True, env=env, cwd=str(REPO))
 
 
+def run_cpu_baseline(deadline_s: float) -> dict:
+    """Serial numpy-interpreter power pass over the full corpus at SF10.
+
+    Same denominator semantics as bench.py's cpu-baseline phase
+    (reference analog: the power_run CPU path, nds/nds_power.py:183-304):
+    wall clock around each result materialization, one process, same
+    host.  A deadline cut records whatever completed; a single query is
+    never allowed to overrun the whole remaining budget (daemon-thread
+    watchdog, same pattern as warm_corpus.py — at SF10 one pathological
+    numpy query could otherwise blow through --cpu_baseline_s by hours)."""
+    import threading
+    import time
+
+    sys.path.insert(0, str(REPO))
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.queries import streamgen
+
+    catalog = loader.load_catalog(str(CACHE / "wh_sf10"))
+    sess = Session(catalog, backend="cpu")
+    queries = streamgen.render_power_corpus()
+    times: dict = {}
+    failed: dict = {}
+    stop_at = time.time() + deadline_s
+    # per-query cap, NOT the whole remaining budget: one wedged query
+    # must cost at most PER_Q, leaving the rest of the corpus measurable
+    per_q = float(os.environ.get("NDSTPU_CPU_QUERY_TIMEOUT_S", "900"))
+
+    def _one(s, sql, slot):
+        try:
+            slot["rows"] = s.sql(sql).to_rows()
+        except Exception as e:  # noqa: BLE001
+            slot["err"] = f"{type(e).__name__}: {e}"
+
+    for name, sql in queries:
+        remaining = stop_at - time.time()
+        if remaining <= 0:
+            break
+        slot: dict = {}
+        th = threading.Thread(target=_one, args=(sess, sql, slot),
+                              daemon=True)
+        t0 = time.time()
+        th.start()
+        th.join(min(per_q, remaining))
+        if th.is_alive():
+            if stop_at - time.time() <= 0:
+                # budget exhausted mid-query, not a per-query hang
+                failed[name] = f"deadline-cut after {remaining:.0f}s"
+                print(f"cpu {name}: CUT", flush=True)
+                break
+            # wedged query: abandon its daemon thread WITH its session
+            # (the interpreter may still mutate session caches) and
+            # continue the corpus on a fresh one — warm_corpus's pattern
+            failed[name] = f"hang>{per_q:.0f}s"
+            print(f"cpu {name}: HANG", flush=True)
+            sess = Session(catalog, backend="cpu")
+            continue
+        if "err" in slot:
+            failed[name] = slot["err"]
+        else:
+            times[name] = round(time.time() - t0, 3)
+        print(f"cpu {name}: {times.get(name, 'ERR')}", flush=True)
+    complete = len(times) == len(queries) and not failed
+    out = {"cpu_times": times, "cpu_failed": failed,
+           "cpu_total_s": round(sum(times.values()), 2),
+           "cpu_queries": len(times), "complete": complete,
+           "fingerprint": _baseline_fingerprint()}
+    # cache ONLY complete clean runs (bench.py's cpu-cache rule): a
+    # deadline-cut or failing pass must not silently become the
+    # denominator of every later SF10_BENCH assembly
+    if complete:
+        (CACHE / "cpu_times_sf10_power.json").write_text(json.dumps(out))
+    return out
+
+
+def _baseline_fingerprint() -> str:
+    """Identity of (warehouse data, rendered corpus, interpreter
+    sources) — bench.py's CPU-cache key, reused so an edit to the numpy
+    interpreter, a template, or a warehouse rebuild all invalidate
+    cached CPU times (stale-denominator hazard, bench.py:184-189)."""
+    sys.path.insert(0, str(REPO))
+    import bench
+    from ndstpu.queries import streamgen
+    return bench._corpus_fingerprint(str(CACHE / "wh_sf10"),
+                                     streamgen.render_power_corpus())
+
+
 def run_validation(queries: str, out_dir: pathlib.Path) -> dict:
+    sys.path.insert(0, str(REPO))
+    from ndstpu.queries.streamgen import BENCH_RNGSEED
+
     wh = str(CACHE / "wh_sf10")
     streams = out_dir / "streams"
     subprocess.run([sys.executable, "-m", "ndstpu.queries.streamgen",
-                    "--streams", "1", "--rngseed", "07291122510",
+                    "--streams", "1", "--rngseed", BENCH_RNGSEED,
                     "--output_dir", str(streams)],
                    check=True, cwd=str(REPO))
     stream = str(streams / "query_0.sql")
@@ -77,10 +167,29 @@ def main() -> int:
     ap.add_argument("--validate_queries", default=DEFAULT_VALIDATE)
     ap.add_argument("--skip_corpus", action="store_true")
     ap.add_argument("--skip_validation", action="store_true")
+    ap.add_argument("--cpu_baseline_s", type=float, default=0.0,
+                    help="seconds to spend on a full-corpus numpy CPU "
+                         "baseline pass (0 = reuse cached / skip)")
     args = ap.parse_args()
     if not args.skip_corpus:
         run_corpus()
     report = {}
+    cpu: dict = {}
+    cpu_cache = CACHE / "cpu_times_sf10_power.json"
+    if args.cpu_baseline_s > 0:
+        cpu = run_cpu_baseline(args.cpu_baseline_s)
+    elif cpu_cache.exists():
+        cpu = json.loads(cpu_cache.read_text())
+        # only complete runs are ever cached, but the warehouse, the
+        # corpus, or the interpreter may have changed since — stale
+        # denominators must not be reused
+        if cpu.get("fingerprint") != _baseline_fingerprint():
+            print("cpu baseline cache is stale (warehouse/corpus/"
+                  "interpreter changed); ignoring", flush=True)
+            cpu = {}
+    if cpu:
+        report["cpu_baseline"] = {k: v for k, v in cpu.items()
+                                  if k != "cpu_times"}
     warm_path = CACHE / "warm_report_sf10.json"
     if warm_path.exists():
         warm = json.loads(warm_path.read_text())
@@ -92,6 +201,30 @@ def main() -> int:
         report["queries_steady"] = len(steady)
         report["steady_total_s"] = round(sum(steady.values()), 2)
         report["failed"] = warm.get("failed", {})
+        cpu_times = cpu.get("cpu_times", {})
+        common = [q for q in steady if q in cpu_times]
+        if common:
+            import math
+            for q in common:
+                report["per_query"][q]["cpu_s"] = cpu_times[q]
+            # one shared set for BOTH headline stats: zero-time entries
+            # (sub-ms rounds to 0.0) are excluded from sums and geomean
+            # alike, so the two numbers describe the same queries
+            ratio_qs = [q for q in common
+                        if steady[q] > 0 and cpu_times[q] > 0]
+            tpu_c = sum(steady[q] for q in ratio_qs)
+            cpu_c = sum(cpu_times[q] for q in ratio_qs)
+            ratios = [cpu_times[q] / steady[q] for q in ratio_qs]
+            report["vs_cpu_baseline"] = {
+                "common_queries": len(common),
+                "ratio_queries": len(ratio_qs),
+                "tpu_steady_s": round(tpu_c, 2),
+                "cpu_s": round(cpu_c, 2),
+                "speedup": round(cpu_c / tpu_c, 3) if tpu_c else 0.0,
+                "geomean_speedup": round(math.exp(
+                    sum(math.log(r) for r in ratios) / len(ratios)), 3)
+                if ratios else 0.0,
+            }
     for cand in (CACHE / "wh_sf10" / "load.txt",
                  CACHE / "wh_sf10_r5_load.txt"):
         try:

@@ -38,10 +38,7 @@ def main() -> None:
     except Exception as e:
         print("preload failed:", e, flush=True)
 
-    queries = []
-    for tpl in streamgen.list_templates():
-        queries.extend(streamgen.render_template_parts(
-            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+    queries = streamgen.render_power_corpus()
     start = sys.argv[1] if len(sys.argv) > 1 else None
     skipping = start is not None
     from bench import _run_one  # shared per-query worker (repo root)

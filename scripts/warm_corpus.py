@@ -29,10 +29,7 @@ try:
 except Exception as e:
     print("preload failed:", e, flush=True)
 
-queries = []
-for tpl in streamgen.list_templates():
-    queries.extend(streamgen.render_template_parts(
-        str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0))
+queries = streamgen.render_power_corpus()
 
 # cheap-first ordering (NDSTPU_WARM_ORDER=<warm_report.json>): under a
 # deadline, warming in ascending known cost covers the most queries
@@ -49,8 +46,11 @@ if _order:
         print(f"order file unusable ({e}); corpus order", flush=True)
 
 # overall deadline (NDSTPU_WARM_DEADLINE_S, wall seconds from start):
-# when exceeded, remaining discover/steady work is skipped — partial
-# warm reports and caches are still written and valid
+# when exceeded, remaining discover work is skipped; steady gets a
+# bounded grace window past it (replays cost ~0.1-2s each, but a wedged
+# TPU turns every replay into a PER_Q hang — the grace cap keeps that
+# worst case from overrunning the deadline by hours).  Partial warm
+# reports and caches are still written and valid.
 _DEADLINE = time.time() + float(
     os.environ.get("NDSTPU_WARM_DEADLINE_S", "1e12"))
 
@@ -65,12 +65,21 @@ def run_one(sess, sql, slot):
 report = {"discover": {}, "steady": {}, "failed": {}}
 only = set(sys.argv[1:])
 for phase in ("discover", "steady"):
+    # a complete steady section keeps the report usable as a timing
+    # artifact even when discovery was cut, so steady runs past the
+    # deadline — but only within a bounded grace window (~5s per
+    # discovered query, 10min floor) measured from when steady STARTS
+    # (a discover query that began just under the deadline may run up
+    # to PER_Q past it; anchoring grace at _DEADLINE would then skip
+    # steady entirely).  The cap exists so a post-discover TPU wedge
+    # (every replay hanging for PER_Q) cannot overrun by hours.
+    cutoff = _DEADLINE
+    if phase == "steady":
+        cutoff = max(_DEADLINE, time.time()) + \
+            max(600.0, 5.0 * len(report["discover"]))
     for name, sql in queries:
-        if phase == "discover" and time.time() > _DEADLINE:
-            # discovery only: steady replays cost ~0.1-2s each, and a
-            # complete steady section keeps the report usable as a
-            # timing artifact even when discovery was cut
-            print("== deadline hit in discover; stopping ==", flush=True)
+        if time.time() > cutoff:
+            print(f"== deadline hit in {phase}; stopping ==", flush=True)
             break
         if only and name not in only: continue
         if name in report["failed"]: continue
