@@ -197,19 +197,25 @@ def _stamp_ok(d: str, fp: str) -> bool:
         return False
 
 
-def _ensure_warehouse() -> str:
-    """Build (or reuse) the SF warehouse.  Each phase writes into a
-    _tmp_ dir renamed only on success: a timeout/SIGTERM mid-build must
-    not leave a truncated dir that later runs mistake for a complete
-    cache (and silently benchmark forever).  Dirs carry a .genfp stamp
-    of the generator sources; a stamp mismatch forces a rebuild."""
-    tag = f"sf{SF:g}"
+def ensure_warehouse(sf: float, datagen_timeout=None,
+                     transcode_timeout=None, quiet: bool = True,
+                     on_phase=None) -> str:
+    """Build (or reuse) the warehouse for one SF.  Each phase writes
+    into a _tmp_ dir renamed only on success: a timeout/SIGTERM
+    mid-build must not leave a truncated dir that later runs mistake
+    for a complete cache (and silently benchmark forever).  Dirs carry
+    a .genfp stamp of the generator sources; a stamp mismatch forces a
+    rebuild.  Shared artifact contract for bench.py (deadline-capped,
+    quiet) and scripts/build_wh.py (uncapped, verbose)."""
+    tag = f"sf{sf:g}"
     raw = os.path.join(CACHE, f"raw_{tag}")
     wh = os.path.join(CACHE, f"wh_{tag}")
     raw_fp = _src_fingerprint(_GEN_SRCS)
     wh_fp = _src_fingerprint(_WH_SRCS)
     for d, fp in ((raw, raw_fp), (wh, wh_fp)):
         if os.path.isdir(d) and os.listdir(d) and not _stamp_ok(d, fp):
+            if not quiet:
+                print(f"stale stamp: rebuilding {d}", flush=True)
             shutil.rmtree(d, ignore_errors=True)
     # append, don't clobber: the host env may carry a sitecustomize dir
     # (e.g. the axon PJRT plugin registration) on PYTHONPATH
@@ -218,25 +224,31 @@ def _ensure_warehouse() -> str:
                PYTHONPATH=f"{REPO}{os.pathsep}{pp}" if pp else REPO)
     for d in (raw + "_tmp_", wh + "_tmp_"):   # stale partials from kills
         shutil.rmtree(d, ignore_errors=True)
-    phase_limit = max(60.0, min(_remaining() - 300.0, 900.0))
+    out = subprocess.DEVNULL if quiet else None
+
+    def _limit(t):   # timeouts may be callables (deadline-relative)
+        return t() if callable(t) else t
+
     if not os.path.isdir(wh) or not os.listdir(wh):
         if not os.path.isdir(raw) or not os.listdir(raw):
-            STATE["phase"] = "datagen"
+            if on_phase:
+                on_phase("datagen")
             tmp = raw + "_tmp_"
             os.makedirs(tmp, exist_ok=True)
             try:
                 subprocess.run(
                     [sys.executable, "-m", "ndstpu.datagen.driver",
-                     "local", f"{SF:g}", "2", tmp, "--overwrite_output"],
-                    check=True, env=env, stdout=subprocess.DEVNULL,
-                    timeout=phase_limit)
+                     "local", f"{sf:g}", "2", tmp, "--overwrite_output"],
+                    check=True, env=env, stdout=out, cwd=REPO,
+                    timeout=_limit(datagen_timeout))
             except BaseException:
                 shutil.rmtree(tmp, ignore_errors=True)
                 raise
             with open(os.path.join(tmp, ".genfp"), "w") as f:
                 f.write(raw_fp)
             os.rename(tmp, raw)
-        STATE["phase"] = "transcode"
+        if on_phase:
+            on_phase("transcode")
         tmp = wh + "_tmp_"
         os.makedirs(tmp, exist_ok=True)
         try:
@@ -244,8 +256,8 @@ def _ensure_warehouse() -> str:
                 [sys.executable, "-m", "ndstpu.io.transcode",
                  "--input_prefix", raw, "--output_prefix", tmp,
                  "--report_file", os.path.join(tmp, "load.txt")],
-                check=True, env=env, stdout=subprocess.DEVNULL,
-                timeout=max(60.0, _remaining() - 240.0))
+                check=True, env=env, stdout=out, cwd=REPO,
+                timeout=_limit(transcode_timeout))
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
@@ -253,6 +265,18 @@ def _ensure_warehouse() -> str:
             f.write(wh_fp)
         os.rename(tmp, wh)
     return wh
+
+
+def _ensure_warehouse() -> str:
+    def _phase(p):
+        STATE["phase"] = p
+
+    return ensure_warehouse(
+        SF,
+        datagen_timeout=lambda: max(60.0, min(_remaining() - 300.0,
+                                              900.0)),
+        transcode_timeout=lambda: max(60.0, _remaining() - 240.0),
+        quiet=True, on_phase=_phase)
 
 
 def _corpus_fingerprint(wh: str, queries) -> str:

@@ -573,9 +573,9 @@ class JEval:
                         bounds=(int(value), int(value)))
         if isinstance(value, float):
             if ctype and ctype.kind == "decimal":
-                return DCol(jnp.full(
-                    cap, round(value * 10 ** ctype.scale), jnp.int64),
-                    valid, ctype)
+                v = round(value * 10 ** ctype.scale)
+                return DCol(jnp.full(cap, v, jnp.int64),
+                            valid, ctype, bounds=(v, v))
             return DCol(jnp.full(cap, value, jnp.float64), valid, FLOAT64)
         if isinstance(value, str):
             d = np.array([value], dtype=object)
@@ -591,8 +591,13 @@ class JEval:
                 # Spark non-ANSI overflow: out-of-precision values -> NULL
                 limit = 10 ** target.precision
                 ok = jnp.abs(c.data) < limit
-                return DCol(c.data, c.valid & ok, target, c.dictionary)
-            return DCol(c.data, c.valid, target, c.dictionary)
+                b = (-(limit - 1), limit - 1)
+                if c.bounds is not None:
+                    b = (max(b[0], c.bounds[0]), min(b[1], c.bounds[1]))
+                return DCol(c.data, c.valid & ok, target, c.dictionary,
+                            bounds=b if b[0] <= b[1] else None)
+            return DCol(c.data, c.valid, target, c.dictionary,
+                        bounds=c.bounds)
         if tk == "float64":
             if k == "decimal":
                 data = c.data.astype(jnp.float64) / (10 ** c.ctype.scale)
@@ -604,14 +609,24 @@ class JEval:
             return DCol(data, c.valid, FLOAT64)
         if tk == "decimal":
             scale = 10 ** target.scale
+            bounds = None
             if k == "decimal":
                 shift = target.scale - c.ctype.scale
                 if shift >= 0:
                     data = c.data * (10 ** shift)
+                    if c.bounds is not None:
+                        m = 10 ** shift
+                        bounds = (c.bounds[0] * m, c.bounds[1] * m)
                 else:
                     d = 10 ** (-shift)
                     sign = jnp.sign(c.data)
                     data = sign * ((jnp.abs(c.data) + d // 2) // d)
+                    if c.bounds is not None:
+                        # round-half-away-from-zero is monotonic
+                        def _rd(v: int) -> int:
+                            s = -1 if v < 0 else 1
+                            return s * ((abs(v) + d // 2) // d)
+                        bounds = (_rd(c.bounds[0]), _rd(c.bounds[1]))
             elif k == "float64":
                 x = c.data * scale
                 data = (jnp.floor(jnp.abs(x) + 0.5) *
@@ -624,18 +639,45 @@ class JEval:
                 return DCol(data, valid, target)
             else:
                 data = c.data.astype(jnp.int64) * scale
-            return DCol(data.astype(jnp.int64), c.valid, target)
+                if k in ("int32", "int64") and c.bounds is not None:
+                    bounds = (c.bounds[0] * scale, c.bounds[1] * scale)
+                elif k == "bool":
+                    bounds = (0, scale)
+            return DCol(data.astype(jnp.int64), c.valid, target,
+                        bounds=bounds)
         if tk in ("int32", "int64"):
             dt = jnp.int64 if tk == "int64" else jnp.int32
+            bounds = None
             if k == "decimal":
                 data = jnp.trunc(
                     c.data / (10 ** c.ctype.scale)).astype(dt)
+                if c.bounds is not None and \
+                        max(abs(c.bounds[0]), abs(c.bounds[1])) < (1 << 53):
+                    # the data path divides in float64; below 2^53 the
+                    # scaled value is exact and trunc(fl(v/s)) == v//s
+                    # (an up-crossing needs s-r <= hi*2^-53 < 1, and
+                    # exact multiples divide exactly), so exact-integer
+                    # bounds match the computed values.  At or above
+                    # 2^53 they can disagree -> no bounds (sort path).
+                    s = 10 ** c.ctype.scale
+                    # trunc-toward-zero is monotonic
+                    def _tr(v: int) -> int:
+                        return -((-v) // s) if v < 0 else v // s
+                    bounds = (_tr(c.bounds[0]), _tr(c.bounds[1]))
             elif k == "string":
                 f, valid = self._string_parse_float(c)
                 return DCol(f.astype(dt), valid, target)
             else:
                 data = c.data.astype(dt)
-            return DCol(data, c.valid, target)
+                if k in ("int32", "int64") and c.bounds is not None:
+                    bounds = c.bounds
+                elif k == "bool":
+                    bounds = (0, 1)
+            if bounds is not None and tk == "int32" and not (
+                    -(1 << 31) <= bounds[0] and bounds[1] < (1 << 31)):
+                # narrowing may wrap valid values; no safe bounds
+                bounds = None
+            return DCol(data, c.valid, target, bounds=bounds)
         if tk == "date":
             if k == "string":
                 return self._string_parse_date(c)

@@ -1,71 +1,28 @@
 """Build (or reuse) the .bench_cache raw+warehouse pair for one SF.
 
-Same artifact contract as bench.py's _ensure_warehouse (tmp dir renamed
-on success, .genfp source-fingerprint stamps) but with no phase time
-caps, so SF10+ builds on a slow host aren't killed mid-generation.
+Thin CLI over bench.ensure_warehouse (same artifact contract: tmp dir
+renamed on success, .genfp source-fingerprint stamps) but with no phase
+time caps and visible subprocess output, so SF10+ builds on a slow host
+aren't killed mid-generation.
 
 Usage: python scripts/build_wh.py <SF>
 """
 from __future__ import annotations
 
-import os
 import pathlib
-import shutil
-import subprocess
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-import bench  # noqa: E402  (repo-root bench.py: stamp + source lists)
+import bench  # noqa: E402  (repo-root bench.py)
 
 
 def main() -> int:
     sf = float(sys.argv[1]) if len(sys.argv) > 1 else 1.0
-    tag = f"sf{sf:g}"
-    cache = REPO / ".bench_cache"
-    raw = cache / f"raw_{tag}"
-    wh = cache / f"wh_{tag}"
-    raw_fp = bench._src_fingerprint(bench._GEN_SRCS)
-    wh_fp = bench._src_fingerprint(bench._WH_SRCS)
-    for d, fp in ((raw, raw_fp), (wh, wh_fp)):
-        if d.is_dir() and os.listdir(d) and not bench._stamp_ok(str(d), fp):
-            print(f"stale stamp: rebuilding {d}", flush=True)
-            shutil.rmtree(d, ignore_errors=True)
-    pp = os.environ.get("PYTHONPATH", "")
-    env = dict(os.environ,
-               PYTHONPATH=f"{REPO}{os.pathsep}{pp}" if pp else str(REPO))
-    for d in (f"{raw}_tmp_", f"{wh}_tmp_"):
-        shutil.rmtree(d, ignore_errors=True)
-    if not (wh.is_dir() and os.listdir(wh)):
-        if not (raw.is_dir() and os.listdir(raw)):
-            tmp = pathlib.Path(f"{raw}_tmp_")
-            tmp.mkdir(parents=True, exist_ok=True)
-            try:
-                subprocess.run(
-                    [sys.executable, "-m", "ndstpu.datagen.driver",
-                     "local", f"{sf:g}", "2", str(tmp),
-                     "--overwrite_output"],
-                    check=True, env=env, cwd=str(REPO))
-            except BaseException:
-                shutil.rmtree(tmp, ignore_errors=True)
-                raise
-            (tmp / ".genfp").write_text(raw_fp)
-            os.rename(tmp, raw)
-            print(f"raw done: {raw}", flush=True)
-        tmp = pathlib.Path(f"{wh}_tmp_")
-        tmp.mkdir(parents=True, exist_ok=True)
-        try:
-            subprocess.run(
-                [sys.executable, "-m", "ndstpu.io.transcode",
-                 "--input_prefix", str(raw), "--output_prefix", str(tmp),
-                 "--report_file", str(tmp / "load.txt")],
-                check=True, env=env, cwd=str(REPO))
-        except BaseException:
-            shutil.rmtree(tmp, ignore_errors=True)
-            raise
-        (tmp / ".genfp").write_text(wh_fp)
-        os.rename(tmp, wh)
+    wh = bench.ensure_warehouse(
+        sf, quiet=False,
+        on_phase=lambda p: print(f"phase: {p}", flush=True))
     print(f"warehouse ready: {wh}", flush=True)
     return 0
 

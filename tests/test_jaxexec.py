@@ -485,6 +485,69 @@ def test_groupby_direct_path_engages(catalog, monkeypatch):
     assert exe._pallas_sum_ok(dt.columns["ss_ext_sales_price"], ngseg)
 
 
+def test_cast_preserves_bounds(catalog):
+    """Value-preserving casts must carry column bounds through, so a
+    CASE whose common type is decimal (or with one int64 branch) stays
+    on the dense/bitmap group-by paths instead of falling to the sort
+    path (r5 roadmap: bounds-through-cast)."""
+    from ndstpu.engine import jaxexec
+    from ndstpu.schema import DType
+
+    dt = jaxexec.to_device(catalog.get("store_sales"))
+    ev = jaxexec.JEval(dt)
+    key = dt.columns["ss_store_sk"]
+    assert key.bounds is not None
+    lo, hi = key.bounds
+
+    # int32 -> int64 widening preserves bounds exactly
+    wide = ev.cast(key, DType("int64"))
+    assert wide.bounds == (lo, hi)
+    # int -> decimal scales bounds by 10^scale
+    dec = ev.cast(key, DType("decimal", precision=12, scale=2))
+    assert dec.bounds == (lo * 100, hi * 100)
+    # decimal identity (same scale, wider precision) keeps bounds
+    dec2 = ev.cast(dec, DType("decimal", precision=18, scale=2))
+    assert dec2.bounds == (lo * 100, hi * 100)
+    # decimal scale-up multiplies; scale-down divides monotonically
+    up = ev.cast(dec, DType("decimal", precision=18, scale=4))
+    assert up.bounds == (lo * 10000, hi * 10000)
+    down = ev.cast(up, DType("decimal", precision=18, scale=2))
+    assert down.bounds == (lo * 100, hi * 100)
+    # decimal -> int truncates toward zero
+    back = ev.cast(dec, DType("int32"))
+    assert back.bounds == (lo, hi)
+
+
+def test_case_of_decimal_literals_keeps_dense_groupby(catalog, cpu_sess):
+    """A CASE key whose common type is decimal must still reach the
+    small-domain direct group-by path (pre-fix: cast() dropped the
+    branch bounds and the plan fell to the full sort path)."""
+    from ndstpu.engine import jaxexec
+
+    sql = ("select case when ss_quantity < 10 then 0.5 "
+           "when ss_quantity < 50 then 1.5 else 2.5 end as bucket, "
+           "count(*) as n, sum(ss_ext_sales_price) as s "
+           "from store_sales group by bucket")
+    sess = Session(catalog, backend="tpu")
+    assert_tables_match(cpu_sess.sql(sql), sess.sql(sql))
+    # the key expression itself must carry bounds through the decimal
+    # casts the CASE inserts
+    dt = jaxexec.to_device(catalog.get("store_sales"))
+    ev = jaxexec.JEval(dt)
+    from ndstpu.engine import expr as ex
+    from ndstpu.schema import DType
+    dt10 = DType("decimal", precision=3, scale=1)
+    case = ex.Case(
+        ((ex.BinOp("<", ex.ColumnRef("ss_quantity"), ex.Literal(10)),
+          ex.Literal(0.5, dt10)),
+         (ex.BinOp("<", ex.ColumnRef("ss_quantity"), ex.Literal(50)),
+          ex.Literal(1.5, dt10))),
+        ex.Literal(2.5, dt10))
+    out = ev.eval(case)
+    assert out.ctype.kind == "decimal"
+    assert out.bounds == (5, 25)
+
+
 def test_coalesce_decimal_literal_stays_decimal(cpu_sess, tpu_sess):
     """Spark types `0.0` as DECIMAL(1,1), so coalesce(decimal, 0.0)
     must stay DECIMAL (exact scaled-int math on TPU) instead of
