@@ -335,35 +335,51 @@ def _run_one(sess, sql: str, slot: dict) -> None:
 
 
 def _power_run(sess, queries, times: dict, failed: list,
-               stop_at: float, rebuild=None) -> bool:
+               stop_at: float, rebuild=None, watchdog=None,
+               per_query_timeout=None, progress: bool = False,
+               hang_abort: int = 3, reasons=None) -> bool:
     """Run the stream serially; returns True iff every query ran.
-    ``rebuild()`` (accel runs) returns a FRESH session after a hang, so
-    the abandoned zombie thread keeps only the old session's state and
-    cannot race the rest of the stream."""
+    ``rebuild()`` returns a FRESH session after a hang, so the
+    abandoned zombie thread keeps only the old session's state and
+    cannot race the rest of the stream.  ``watchdog`` defaults to on
+    for accelerator runs; pass True to also bound CPU queries (SF10+
+    interpreter passes, where one pathological numpy query could
+    otherwise blow through the whole budget).  ``hang_abort`` bounds
+    consecutive-run hang tolerance: N hangs mean a wedged backend on
+    accelerators, but independent slow queries on a CPU interpreter —
+    pass 0 to never abort (each hang still costs at most the per-query
+    timeout).  ``reasons`` (dict) collects a per-query failure reason
+    alongside the bare names in ``failed``."""
     import threading
     accel = sess.backend != "cpu"
+    qto = per_query_timeout if per_query_timeout else QUERY_TIMEOUT_S
+    if watchdog is None:
+        watchdog = accel
     hangs = 0
     for name, sql in queries:
         if time.time() >= stop_at:
             return False
         t0 = time.time()
         slot: dict = {}
-        if accel:
+        if watchdog:
             th = threading.Thread(target=_run_one, args=(sess, sql, slot),
                                   daemon=True)
             th.start()
-            waited = min(QUERY_TIMEOUT_S, max(30.0, stop_at - time.time()))
+            waited = min(qto, max(30.0, stop_at - time.time()))
             th.join(waited)
             if th.is_alive():
-                if waited < QUERY_TIMEOUT_S:
+                if waited < qto:
                     # deadline cut an ordinary query, not a hang
                     return False
                 print(f"BENCH-ERROR {name}: hang (> "
-                      f"{QUERY_TIMEOUT_S:.0f}s), abandoned",
+                      f"{qto:.0f}s), abandoned",
                       file=sys.stderr, flush=True)
                 failed.append(name)
+                if reasons is not None:
+                    reasons[name] = f"hang>{qto:.0f}s"
                 hangs += 1
-                if hangs >= 3:  # backend wedged, not one bad program
+                if hang_abort and hangs >= hang_abort:
+                    # backend wedged, not one bad program
                     print("BENCH-WARNING: repeated hangs, aborting run",
                           file=sys.stderr, flush=True)
                     return False
@@ -382,12 +398,16 @@ def _power_run(sess, queries, times: dict, failed: list,
             _run_one(sess, sql, slot)
         if slot.get("ok"):
             times[name] = round(time.time() - t0, 4)
+            if progress:
+                print(f"{name}: {times[name]:.3f}s", flush=True)
             continue
         e = slot.get("err")
         # a failed query must not zero the whole 99-query benchmark
         print(f"BENCH-ERROR {name}: {type(e).__name__}: {e}",
               file=sys.stderr, flush=True)
         failed.append(name)
+        if reasons is not None:
+            reasons[name] = str(e)
         if accel and any(tok in str(e) for tok in _BACKEND_DEAD):
             # the TPU worker died: every further query would fail the
             # same way — abort this run so the report stays scoped to

@@ -48,64 +48,32 @@ def run_cpu_baseline(deadline_s: float) -> dict:
     Same denominator semantics as bench.py's cpu-baseline phase
     (reference analog: the power_run CPU path, nds/nds_power.py:183-304):
     wall clock around each result materialization, one process, same
-    host.  A deadline cut records whatever completed; a single query is
-    never allowed to overrun the whole remaining budget (daemon-thread
-    watchdog, same pattern as warm_corpus.py — at SF10 one pathological
-    numpy query could otherwise blow through --cpu_baseline_s by hours)."""
-    import threading
+    host.  Reuses bench._power_run with the CPU watchdog on — a
+    deadline cut records whatever completed, and a single wedged numpy
+    query costs at most NDSTPU_CPU_QUERY_TIMEOUT_S, never the whole
+    remaining budget."""
     import time
 
     sys.path.insert(0, str(REPO))
+    import bench
     from ndstpu.engine.session import Session
     from ndstpu.io import loader
     from ndstpu.queries import streamgen
 
     catalog = loader.load_catalog(str(CACHE / "wh_sf10"))
-    sess = Session(catalog, backend="cpu")
     queries = streamgen.render_power_corpus()
     times: dict = {}
-    failed: dict = {}
-    stop_at = time.time() + deadline_s
-    # per-query cap, NOT the whole remaining budget: one wedged query
-    # must cost at most PER_Q, leaving the rest of the corpus measurable
+    failed: list = []
+    reasons: dict = {}
     per_q = float(os.environ.get("NDSTPU_CPU_QUERY_TIMEOUT_S", "900"))
-
-    def _one(s, sql, slot):
-        try:
-            slot["rows"] = s.sql(sql).to_rows()
-        except Exception as e:  # noqa: BLE001
-            slot["err"] = f"{type(e).__name__}: {e}"
-
-    for name, sql in queries:
-        remaining = stop_at - time.time()
-        if remaining <= 0:
-            break
-        slot: dict = {}
-        th = threading.Thread(target=_one, args=(sess, sql, slot),
-                              daemon=True)
-        t0 = time.time()
-        th.start()
-        th.join(min(per_q, remaining))
-        if th.is_alive():
-            if stop_at - time.time() <= 0:
-                # budget exhausted mid-query, not a per-query hang
-                failed[name] = f"deadline-cut after {remaining:.0f}s"
-                print(f"cpu {name}: CUT", flush=True)
-                break
-            # wedged query: abandon its daemon thread WITH its session
-            # (the interpreter may still mutate session caches) and
-            # continue the corpus on a fresh one — warm_corpus's pattern
-            failed[name] = f"hang>{per_q:.0f}s"
-            print(f"cpu {name}: HANG", flush=True)
-            sess = Session(catalog, backend="cpu")
-            continue
-        if "err" in slot:
-            failed[name] = slot["err"]
-        else:
-            times[name] = round(time.time() - t0, 3)
-        print(f"cpu {name}: {times.get(name, 'ERR')}", flush=True)
-    complete = len(times) == len(queries) and not failed
-    out = {"cpu_times": times, "cpu_failed": failed,
+    ran_all = bench._power_run(
+        Session(catalog, backend="cpu"), queries, times, failed,
+        stop_at=time.time() + deadline_s,
+        rebuild=lambda: Session(catalog, backend="cpu"),
+        watchdog=True, per_query_timeout=per_q, progress=True,
+        hang_abort=0, reasons=reasons)
+    complete = ran_all and len(times) == len(queries) and not failed
+    out = {"cpu_times": times, "cpu_failed": reasons,
            "cpu_total_s": round(sum(times.values()), 2),
            "cpu_queries": len(times), "complete": complete,
            "fingerprint": _baseline_fingerprint()}
