@@ -1,0 +1,198 @@
+"""Localize the power-CLI program-variant recompile (STATUS roadmap 4).
+
+Observation: for segment-bearing queries, a fresh process that preloads
+size-plan records compiles a *different XLA cache key* than the process
+that originally discovered the query — the persistent cache misses and
+the first power-CLI run pays a surprise compile even though the HLO
+"looks" identical.
+
+Method: run the SAME query twice, in two fresh subprocesses —
+  A) discover: no records, full eager discovery + warm replay
+  B) records:  preload .bench_cache/plans_sf<SF>.pkl, straight replay
+— with ``jax._src.cache_key.get`` wrapped to record, per compiled
+program: the module sym_name, the final cache key, the sha256 of each
+key COMPONENT (computation / jax_lib versions / XLA flags / compile
+options / accelerator config / compression), and the serialized MLIR
+text.  The parent aligns programs by (sym_name, occurrence index) and
+reports the first component whose hash differs; when it is the
+computation itself, a unified diff of the MLIR localizes the divergent
+op.
+
+Usage:
+    python scripts/variant_probe.py query1            # orchestrate
+    python scripts/variant_probe.py --child discover query1 out.json
+"""
+
+from __future__ import annotations
+
+import difflib
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CACHE = REPO / ".bench_cache"
+SF = f"{float(os.environ.get('NDSTPU_BENCH_SF', '1')):g}"
+OUT = CACHE / "variant_probe"
+
+
+def child(mode: str, qname: str, out_path: str) -> None:
+    sys.path.insert(0, str(REPO))
+    import hashlib
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      str(CACHE / "xla_cache_tpu"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    from jax._src import cache_key as ck
+
+    calls: list = []
+    orig_get = ck.get
+
+    def probed_get(module, devices, compile_options, backend,
+                   compression_algorithm="zstandard",
+                   ignore_callbacks=ck.IgnoreCallbacks.NO):
+        key = orig_get(module, devices, compile_options, backend,
+                       compression_algorithm, ignore_callbacks)
+        # recompute each component hash exactly as cache_key.get does,
+        # via its own private helpers (version-pinned jax 0.9.0)
+        comp = {}
+        try:
+            def h(fn):
+                o = hashlib.sha256()
+                fn(o)
+                return o.digest().hex()
+
+            comp["computation"] = h(
+                lambda o: ck._hash_computation(o, module,
+                                               ignore_callbacks))
+            comp["backend version"] = h(
+                lambda o: ck._hash_platform(o, backend))
+            comp["XLA flags"] = h(lambda o: ck._hash_xla_flags(
+                o, ck.get_flag_prefixes()))
+            comp["compile_options"] = h(
+                lambda o: ck._hash_serialized_compile_options(
+                    o, compile_options,
+                    strip_device_assignment=(backend.platform == "gpu")))
+            comp["accelerator_config"] = h(
+                lambda o: ck._hash_accelerator_config(o, devices))
+        except Exception as e:  # noqa: BLE001 — helper drift: keep key
+            comp["error"] = f"{type(e).__name__}: {e}"
+        idx = len(calls)
+        mlir_path = f"{out_path}.{mode}.{idx}.mlir"
+        try:
+            with open(mlir_path, "w") as f:
+                f.write(str(module))
+        except Exception:  # noqa: BLE001
+            mlir_path = None
+        try:
+            from jax._src.lib.mlir import ir
+            name = ir.StringAttr(
+                module.operation.attributes["sym_name"]).value
+        except Exception:  # noqa: BLE001
+            name = "?"
+        calls.append({"sym_name": name, "key": key, "components": comp,
+                      "mlir": mlir_path})
+        return key
+
+    ck.get = probed_get
+
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    from ndstpu.queries import streamgen
+
+    catalog = loader.load_catalog(str(CACHE / f"wh_sf{SF}"))
+    sess = Session(catalog, backend="tpu")
+    if mode == "records":
+        n = sess.preload_compiled(str(CACHE / f"plans_sf{SF}.pkl"))
+        print(f"preloaded {n} records", flush=True)
+    queries = dict(streamgen.render_power_corpus())
+    sql = queries[qname]
+    sess.sql(sql).to_rows()
+    with open(out_path, "w") as f:
+        json.dump(calls, f, indent=1)
+    print(f"{mode}: {len(calls)} cache-key computations", flush=True)
+
+
+def _align(a: list, b: list):
+    """Pair program records by (sym_name, occurrence index)."""
+    from collections import defaultdict
+    occ_a: dict = defaultdict(list)
+    occ_b: dict = defaultdict(list)
+    for r in a:
+        occ_a[r["sym_name"]].append(r)
+    for r in b:
+        occ_b[r["sym_name"]].append(r)
+    pairs, only_a, only_b = [], [], []
+    for name in {*occ_a, *occ_b}:
+        xs, ys = occ_a.get(name, []), occ_b.get(name, [])
+        for i in range(max(len(xs), len(ys))):
+            if i < len(xs) and i < len(ys):
+                pairs.append((f"{name}#{i}", xs[i], ys[i]))
+            elif i < len(xs):
+                only_a.append(f"{name}#{i}")
+            else:
+                only_b.append(f"{name}#{i}")
+    return pairs, only_a, only_b
+
+
+def main() -> int:
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2], sys.argv[3], sys.argv[4])
+        return 0
+    qname = sys.argv[1] if len(sys.argv) > 1 else "query1"
+    OUT.mkdir(parents=True, exist_ok=True)
+    outs = {}
+    for mode in ("discover", "records"):
+        out = OUT / f"{qname}.{mode}.json"
+        outs[mode] = out
+        print(f"== child: {mode} ==", flush=True)
+        subprocess.run(
+            [sys.executable, __file__, "--child", mode, qname, str(out)],
+            check=True, cwd=str(REPO))
+    a = json.load(open(outs["discover"]))
+    b = json.load(open(outs["records"]))
+    pairs, only_a, only_b = _align(a, b)
+    if only_a:
+        print(f"programs only in discover: {only_a}")
+    if only_b:
+        print(f"programs only in records:  {only_b}")
+    n_diff = 0
+    for tag, ra, rb in pairs:
+        if ra["key"] == rb["key"]:
+            print(f"{tag}: MATCH ({ra['key'][-16:]})")
+            continue
+        n_diff += 1
+        print(f"{tag}: KEY DIFFERS")
+        ca, cb = ra["components"], rb["components"]
+        named = False
+        for name in sorted({**ca, **cb}):
+            if ca.get(name) == cb.get(name):
+                continue
+            named = True
+            print(f"  component '{name}' differs "
+                  f"({str(ca.get(name, 'MISSING'))[:12]} vs "
+                  f"{str(cb.get(name, 'MISSING'))[:12]})")
+            if name == "computation" and ra["mlir"] and rb["mlir"]:
+                ta = open(ra["mlir"]).read().splitlines()
+                tb = open(rb["mlir"]).read().splitlines()
+                d = list(difflib.unified_diff(
+                    ta, tb, "discover", "records", lineterm="", n=1))
+                print(f"  mlir diff: {len(d)} lines (first 60 below)")
+                for line in d[:60]:
+                    print(f"    {line}")
+        if not named:
+            # the differing input must be one the probe does not
+            # recompute (jax_lib version / compression / custom_hook)
+            print("  no recomputed component differs — divergence is "
+                  "in jax_lib version, compression, or custom_hook")
+    print(f"== {n_diff} differing program(s) over {len(pairs)} pairs ==")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

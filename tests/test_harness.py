@@ -347,6 +347,31 @@ def test_full_bench_end_to_end(tmp_path, env):
     assert list((root / "json").glob("*-query3-*.json"))
 
 
+def test_resolve_stream_rngseed(tmp_path):
+    """An explicit `rngseed:` pin wins; otherwise the seed chains from
+    the load report end timestamp (reference nds_bench.py:249-261; the
+    pin mirrors nds_gen_query_stream.py's explicit --rngseed)."""
+    report = tmp_path / "load.txt"
+    report.write_text("Load Test Time: 12 seconds\n"
+                      "RNGSEED used: 08021530120\n")
+    assert bench_mod.resolve_stream_rngseed(
+        {}, str(report)) == "08021530120"
+    assert bench_mod.resolve_stream_rngseed(
+        {"rngseed": "01151230000"}, str(report)) == "01151230000"
+    # the sentinel resolves to the single warmed-corpus seed constant
+    from ndstpu.queries.streamgen import BENCH_RNGSEED
+    assert bench_mod.resolve_stream_rngseed(
+        {"rngseed": "bench"}, str(report)) == BENCH_RNGSEED
+    # unquoted yaml seeds parse as ints (octal for 0-prefixed Jan-Jul
+    # timestamps) and silently pin the wrong corpus — refused outright
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        bench_mod.resolve_stream_rngseed({"rngseed": 0}, str(report))
+    with _pytest.raises(ValueError):
+        bench_mod.resolve_stream_rngseed(
+            {"rngseed": 161820672}, str(report))
+
+
 def test_metric_formula():
     m = bench_mod.get_perf_metric("100", 2, 99, 1000.0, 500.0, 300.0,
                                   310.0, 60.0, 65.0)
