@@ -55,6 +55,7 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 
+from ndstpu import obs  # noqa: E402
 from ndstpu.engine import columnar, expr as ex, physical, plan as lp  # noqa: E402
 from ndstpu.engine.columnar import (  # noqa: E402
     BOOL,
@@ -1523,7 +1524,9 @@ class JaxExecutor:
         cached = self._device_cache.get(name)
         if cached is not None and cached[0] == version and \
                 version is not None:
+            obs.inc("engine.cache.device.hit")
             return cached[1]
+        obs.inc("engine.cache.device.miss")
         # always materialize on the HOST backend: this cache feeds
         # eager/discovery and replay metadata; pinning a second full
         # copy of every table in accelerator HBM (alongside the
@@ -3106,7 +3109,9 @@ class CompilingExecutor(JaxExecutor):
         if cp is not None and cp.versions != versions:
             cp = None
         if cp is None:
+            obs.inc("engine.cache.compiled.miss")
             return self._discover_query(p, key, versions)
+        obs.inc("engine.cache.compiled.hit")
         if not cp.compilable:
             result = self._eager_with_segments(cp)
             if result is None:   # a shared segment was evicted: rebuild
@@ -3175,19 +3180,37 @@ class CompilingExecutor(JaxExecutor):
 
     # -- replay ---------------------------------------------------------------
 
-    def _replay_query(self, cp: _CompiledPlan) -> Optional[Table]:
+    def _replay_query(self, cp: _CompiledPlan,
+                      bucket: str = "execute_s") -> Optional[Table]:
         """Dispatch segment programs then the parent; ONE batched
         device->host fetch at the end (a fetch costs a tunnel round
-        trip).  None = some size guard failed (data changed)."""
+        trip).  None = some size guard failed (data changed).
+
+        The whole replay runs under a tracer span attributed to
+        ``bucket`` — ``execute_s`` normally, ``compile_s`` for the
+        discovery-time warm-up call that pays the XLA compile — so the
+        harness's per-query cost split is self-labeling.  The finer
+        host-prep/device/fetch sub-split (NDSTPU_ATTRIB=1) keeps its
+        opt-in: it needs a block_until_ready that serializes the
+        device pipeline."""
+        with obs.span("replay", cat="plan-node", bucket=bucket,
+                      n_programs=1 + len(cp.seg_fps or ())) as sp:
+            result = self._replay_query_timed(cp, sp)
+        return result
+
+    def _replay_query_timed(self, cp: _CompiledPlan,
+                            sp) -> Optional[Table]:
         attrib = self.attrib_enabled
-        t_start = time.perf_counter() if attrib else 0.0
+        t_start = time.perf_counter()
         seg_args = {}
         seg_oks = []
         seg_flop_args: list = []
         for fp in (cp.seg_fps or ()):
             scp = self._seg_compiled.get(fp)
             if scp is None or scp.versions != cp.versions:
+                obs.inc("engine.cache.seg_compiled.miss")
                 return None
+            obs.inc("engine.cache.seg_compiled.hit")
             if scp.compilable:
                 if scp.fn is None:
                     scp.fn = self._build_jit(scp)
@@ -3207,7 +3230,7 @@ class CompilingExecutor(JaxExecutor):
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
         args.update(seg_args)
-        t_dispatch = time.perf_counter() if attrib else 0.0
+        t_dispatch = time.perf_counter()
         (out, alive), ok = cp.fn(args)
         if attrib:
             # serialize: device span ends when every output is ready,
@@ -3216,11 +3239,14 @@ class CompilingExecutor(JaxExecutor):
             t_ready = time.perf_counter()
         (out, alive_np), okv, seg_okv = jax.device_get(
             ((out, alive), ok, seg_oks))
+        t_fetched = time.perf_counter()
+        fetched = int(alive_np.nbytes) + sum(
+            d.nbytes + v.nbytes for d, v in out.values())
+        obs.inc("engine.fetched_bytes", fetched)
+        sp.set(host_prep_s=round(t_dispatch - t_start, 5),
+               fetched_bytes=fetched)
         if attrib:
-            t_fetched = time.perf_counter()
-            fetched = int(alive_np.nbytes) + sum(
-                d.nbytes + v.nbytes for d, v in out.values())
-            self.last_attribution = {
+            attribution = {
                 "host_prep_s": round(t_dispatch - t_start, 5),
                 "device_s": round(t_ready - t_dispatch, 5),
                 "fetch_s": round(t_fetched - t_ready, 5),
@@ -3228,6 +3254,10 @@ class CompilingExecutor(JaxExecutor):
                 "n_programs": 1 + len(cp.seg_fps or ()),
                 "flops": self._cost_flops(cp, args, seg_flop_args),
             }
+            self.last_attribution = attribution
+            sp.set(device_s=attribution["device_s"],
+                   fetch_s=attribution["fetch_s"],
+                   flops=attribution["flops"])
         if not (bool(okv) and all(bool(o) for o in seg_okv)):
             return None
         for fp in (cp.seg_fps or ()):
@@ -3321,11 +3351,28 @@ class CompilingExecutor(JaxExecutor):
     # -- discovery ------------------------------------------------------------
 
     def _discover_query(self, p: lp.Plan, key: str, versions) -> Table:
+        # the whole first-ever pass — eager discovery, jit builds, and
+        # the warm-up replay that pays the XLA compile — is cold-path
+        # cost a steady-state run never pays: bucket it as compile_s so
+        # headline numbers are self-labeling (round-5 verdict: a cold
+        # run was committed as warm because nothing could tell)
+        with obs.span("discover_query", cat="plan-node",
+                      bucket="compile_s", n_segments=0) as sp:
+            obs.inc("engine.discoveries")
+            return self._discover_query_traced(p, key, versions, sp)
+
+    def _discover_query_traced(self, p: lp.Plan, key: str, versions,
+                               sp) -> Table:
         parent, segs = _cut_segments(p)
+        sp.set(n_segments=len(segs))
         self._seg_tables = {}
         for fp, sub in segs.items():
             dt = None
             scp = self._seg_compiled.get(fp)
+            if scp is not None and scp.versions == versions:
+                obs.inc("engine.cache.seg_compiled.hit")
+            else:
+                obs.inc("engine.cache.seg_compiled.miss")
             if scp is not None and scp.versions == versions:
                 # already compiled for another query (part): replay it
                 # for values instead of re-running eager discovery
@@ -3361,7 +3408,9 @@ class CompilingExecutor(JaxExecutor):
             # A warm failure is not fatal: the next execute_cached
             # replays (or demotes) through the normal path.
             try:
-                if self._replay_query(cp) is not None:
+                # the warm-up call pays the XLA compile inside fn():
+                # bucket it compile_s, not execute_s
+                if self._replay_query(cp, bucket="compile_s") is not None:
                     cp.fn_validated = True
             except Exception as e:  # noqa: BLE001
                 import warnings
@@ -3630,6 +3679,11 @@ class CompilingExecutor(JaxExecutor):
 
     def _build_jit(self, cp: _CompiledPlan):
         self.n_jit_builds += 1
+        obs.inc("engine.jit_builds")
+        with obs.span("build_jit", cat="plan-node", bucket="compile_s"):
+            return self._build_jit_traced(cp)
+
+    def _build_jit_traced(self, cp: _CompiledPlan):
         metas = {}
         for name in cp.table_cols:
             dt = self._table_device(name)

@@ -55,6 +55,19 @@ class Session:
 
     def _run(self, stmt: ast.Node,
              key: Optional[str] = None) -> Optional[columnar.Table]:
+        # the whole statement is execute_s; cold-path work nested inside
+        # (discovery, jit builds) carries its own compile_s bucket and
+        # is subtracted by the tracer's self-time accounting, so the
+        # per-query compile/execute split needs no bookkeeping here
+        from ndstpu import obs
+        with obs.span("statement", cat="plan-node", bucket="execute_s",
+                      kind=type(stmt).__name__, backend=self.backend):
+            return self._run_traced(stmt, key)
+
+    def _run_traced(self, stmt: ast.Node,
+                    key: Optional[str] = None
+                    ) -> Optional[columnar.Table]:
+        from ndstpu import obs
         if isinstance(stmt, ast.Query):
             # plan cache: a steady-state replay of a compiled query must
             # not re-plan + re-optimize the SQL every call (50-150 ms of
@@ -77,13 +90,16 @@ class Session:
                 ent = pc.get(key)
                 if ent is not None and ent[0] != state:
                     ent = None
+                obs.inc("engine.cache.plan.hit" if ent is not None
+                        else "engine.cache.plan.miss")
             if ent is None:
-                planner = pl.Planner(self.catalog, dict(self.views))
-                plan, cols = planner.plan_query(stmt)
-                from ndstpu.engine.optimizer import optimize
-                plan = optimize(plan, self.catalog)
-                # display names: strip alias qualifiers
-                disp = self._dedupe(planner._display_names(cols))
+                with obs.span("plan", cat="plan-node"):
+                    planner = pl.Planner(self.catalog, dict(self.views))
+                    plan, cols = planner.plan_query(stmt)
+                    from ndstpu.engine.optimizer import optimize
+                    plan = optimize(plan, self.catalog)
+                    # display names: strip alias qualifiers
+                    disp = self._dedupe(planner._display_names(cols))
                 if key is not None:
                     pc[key] = (state, plan, disp)
             else:
@@ -153,6 +169,9 @@ class Session:
                 # device args go with it) and rebuild below
                 del cache[ck]
                 ent = None
+            from ndstpu import obs
+            obs.inc("engine.cache.spmd.hit" if ent is not None
+                    else "engine.cache.spmd.miss")
             if ent is not None:
                 try:
                     out = ent[1].execute_again()
@@ -180,7 +199,7 @@ class Session:
             except (dplan.DistUnsupported, jaxexec.Unsupported):
                 # plan shape or an expression outside the distributed
                 # subset: the single-chip path below has per-plan fallback
-                pass
+                obs.inc("engine.spmd.unsupported_fallbacks")
             except Exception as e:  # noqa: BLE001
                 # a distributed-executor defect must degrade to the
                 # single-chip path, not fail the query; strict mode
@@ -203,6 +222,9 @@ class Session:
         import os
         import sys
         import warnings
+
+        from ndstpu import obs
+        obs.inc("engine.spmd.error_fallbacks")
         if os.environ.get("NDSTPU_SPMD_STRICT"):
             raise e
         errs = getattr(self, "_spmd_errors", None)

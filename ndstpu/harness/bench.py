@@ -18,14 +18,31 @@ metric = int(SF * Sq*Q / (Tpt*Ttt*Tdm*Tld)^(1/4)) in decimal hours
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import os
 import subprocess
 import sys
+import time
+from contextlib import contextmanager
 
 import yaml
 
+from ndstpu import obs
+
 PY = [sys.executable, "-m"]
+
+
+@contextmanager
+def _phase(name: str, walls: dict):
+    """Time one bench phase: a tracer span (cat='phase') plus a wall
+    entry for the HW metrics artifact.  Phases run as subprocesses, so
+    per-query spans live in the power runner's own trace; the driver
+    records the phase envelope and stitches the power sidecar in."""
+    t0 = time.time()
+    with obs.span(name, cat="phase"):
+        yield
+    walls[name] = round(time.time() - t0, 3)
 
 
 def round_up_to_nearest_10_percent(num: float) -> float:
@@ -67,16 +84,19 @@ def resolve_stream_rngseed(stream_cfg: dict, load_report_file: str) -> str:
         from ndstpu.queries.streamgen import BENCH_RNGSEED
         return BENCH_RNGSEED
     if not isinstance(seed, str):
-        # yaml parses unquoted digit seeds as ints — an 0-prefixed
-        # timestamp seed of octal digits (any Jan-Jul load end time)
-        # resolves to a DIFFERENT number, and int()-ing also drops
-        # leading zeros: either way the pin silently renders the wrong
-        # corpus.  Refuse instead of guessing.
+        # yaml parses unquoted digit seeds as ints.  PyYAML octal-parses
+        # an unquoted 0-prefixed seed ONLY when its digits are all 0-7
+        # (YAML 1.1 resolver `0[0-7]+`) — such a timestamp resolves to a
+        # DIFFERENT number; a 0-prefixed seed containing an 8 or 9
+        # matches neither the octal nor the decimal form and safely
+        # stays a string.  Any seed that reached here as an int has at
+        # minimum lost its leading zeros, so the pin would silently
+        # render the wrong corpus.  Refuse instead of guessing.
         raise ValueError(
             f"generate_query_stream.rngseed must be a quoted string "
-            f"(got {type(seed).__name__} {seed!r}; unquoted yaml seeds "
-            f"lose leading zeros / parse as octal) or the sentinel "
-            f"'bench'")
+            f"(got {type(seed).__name__} {seed!r}; unquoted seeds lose "
+            f"leading zeros, and 0-prefixed seeds whose digits are all "
+            f"0-7 parse as octal) or the sentinel 'bench'")
     return seed
 
 
@@ -169,89 +189,102 @@ def run_full_bench(yaml_params: dict) -> None:
     sf = str(d["scale_factor"])
     num_streams = int(g["num_streams"])
     sq = max(len(get_stream_range(num_streams, 1)), 1)
+    phase_walls: dict = {}
 
     # 1. data generation (+ per-stream refresh sets)
     if not d.get("skip"):
-        run(PY + ["ndstpu.datagen.driver", "local", sf,
-                  str(d["parallel"]), d["data_path"], "--overwrite_output"])
-        for i in range(1, num_streams):
+        with _phase("data_gen", phase_walls):
             run(PY + ["ndstpu.datagen.driver", "local", sf,
-                      str(d["parallel"]), d["data_path"] + f"_{i}",
-                      "--overwrite_output", "--update", str(i)])
+                      str(d["parallel"]), d["data_path"],
+                      "--overwrite_output"])
+            for i in range(1, num_streams):
+                run(PY + ["ndstpu.datagen.driver", "local", sf,
+                          str(d["parallel"]), d["data_path"] + f"_{i}",
+                          "--overwrite_output", "--update", str(i)])
 
     # 2. load test
     if not l.get("skip"):
-        run(PY + ["ndstpu.io.transcode",
-                  "--input_prefix", d["data_path"],
-                  "--output_prefix", l["warehouse_path"],
-                  "--report_file", l["report_file"],
-                  "--output_format", l.get("warehouse_format", "parquet")])
+        with _phase("load_test", phase_walls):
+            run(PY + ["ndstpu.io.transcode",
+                      "--input_prefix", d["data_path"],
+                      "--output_prefix", l["warehouse_path"],
+                      "--report_file", l["report_file"],
+                      "--output_format",
+                      l.get("warehouse_format", "parquet")])
     load_elapse = get_load_time(l["report_file"])
 
     # 3. query streams (RNGSEED = load end timestamp, spec 4.3.1, or a
     #    pinned `rngseed:` override — see resolve_stream_rngseed)
     if not g.get("skip"):
-        rngseed = resolve_stream_rngseed(g, l["report_file"])
-        cmd = PY + ["ndstpu.queries.streamgen",
-                    "--output_dir", g["stream_output_path"],
-                    "--rngseed", rngseed,
-                    "--streams", str(num_streams)]
-        if g.get("template_dir"):
-            cmd += ["--template_dir", g["template_dir"]]
-        run(cmd)
+        with _phase("generate_query_stream", phase_walls):
+            rngseed = resolve_stream_rngseed(g, l["report_file"])
+            cmd = PY + ["ndstpu.queries.streamgen",
+                        "--output_dir", g["stream_output_path"],
+                        "--rngseed", rngseed,
+                        "--streams", str(num_streams)]
+            if g.get("template_dir"):
+                cmd += ["--template_dir", g["template_dir"]]
+            run(cmd)
 
     # 4. power test
     if not p.get("skip"):
-        if p.get("json_summary_folder"):
-            import shutil
-            shutil.rmtree(p["json_summary_folder"], ignore_errors=True)
-        cmd = PY + ["ndstpu.harness.power",
-                    os.path.join(g["stream_output_path"], "query_0.sql"),
-                    l["warehouse_path"], p["report_file"],
-                    "--engine", p.get("engine", "cpu")]
-        if p.get("json_summary_folder"):
-            cmd += ["--json_summary_folder", p["json_summary_folder"]]
-        if p.get("output_prefix"):
-            cmd += ["--output_prefix", p["output_prefix"]]
-        if p.get("compile_records"):
-            # persisted size-plan records (+ the NDSTPU_XLA_CACHE_DIR
-            # persistent cache): accel engines skip per-query discovery.
-            # Absolutized so subprocess cwd can't silently miss it.
-            rec = os.path.abspath(p["compile_records"])
-            p["compile_records"] = rec
-            if not os.path.exists(rec):
-                print(f"WARNING: compile_records {rec} does not exist "
-                      f"yet — accel power runs will pay full discovery")
-            cmd += ["--compile_records", rec]
-        run(cmd)
+        with _phase("power_test", phase_walls):
+            if p.get("json_summary_folder"):
+                import shutil
+                shutil.rmtree(p["json_summary_folder"], ignore_errors=True)
+            cmd = PY + ["ndstpu.harness.power",
+                        os.path.join(g["stream_output_path"],
+                                     "query_0.sql"),
+                        l["warehouse_path"], p["report_file"],
+                        "--engine", p.get("engine", "cpu")]
+            if p.get("json_summary_folder"):
+                cmd += ["--json_summary_folder", p["json_summary_folder"]]
+            if p.get("output_prefix"):
+                cmd += ["--output_prefix", p["output_prefix"]]
+            if p.get("compile_records"):
+                # persisted size-plan records (+ the NDSTPU_XLA_CACHE_DIR
+                # persistent cache): accel engines skip per-query
+                # discovery.  Absolutized so subprocess cwd can't
+                # silently miss it.
+                rec = os.path.abspath(p["compile_records"])
+                p["compile_records"] = rec
+                if not os.path.exists(rec):
+                    print(f"WARNING: compile_records {rec} does not "
+                          f"exist yet — accel power runs will pay full "
+                          f"discovery")
+                cmd += ["--compile_records", rec]
+            run(cmd)
     power_elapse = float(get_power_time(p["report_file"])) / 1000
 
     # 5./6. throughput + maintenance, twice
     ttt, tdm = {}, {}
     for fs in (1, 2):
         if not t.get("skip"):
-            ids = ",".join(str(x) for x in get_stream_range(num_streams, fs))
-            tcmd = PY + ["ndstpu.harness.throughput", ids]
-            if t.get("concurrent"):
-                # device admission: at most N streams on the chip at a
-                # time (the concurrentGpuTasks analog)
-                tcmd += ["--concurrent", str(t["concurrent"])]
-            pcmd = PY + ["ndstpu.harness.power",
-                         os.path.join(g["stream_output_path"],
-                                      "query_{}.sql"),
-                         l["warehouse_path"],
-                         t["report_base"] + "_{}.csv",
-                         "--engine", p.get("engine", "cpu")]
-            if p.get("compile_records"):
-                pcmd += ["--compile_records", p["compile_records"]]
-            run(tcmd + ["--"] + pcmd)
+            with _phase(f"throughput_test_{fs}", phase_walls):
+                ids = ",".join(str(x) for x in
+                               get_stream_range(num_streams, fs))
+                tcmd = PY + ["ndstpu.harness.throughput", ids]
+                if t.get("concurrent"):
+                    # device admission: at most N streams on the chip at
+                    # a time (the concurrentGpuTasks analog)
+                    tcmd += ["--concurrent", str(t["concurrent"])]
+                pcmd = PY + ["ndstpu.harness.power",
+                             os.path.join(g["stream_output_path"],
+                                          "query_{}.sql"),
+                             l["warehouse_path"],
+                             t["report_base"] + "_{}.csv",
+                             "--engine", p.get("engine", "cpu")]
+                if p.get("compile_records"):
+                    pcmd += ["--compile_records", p["compile_records"]]
+                run(tcmd + ["--"] + pcmd)
         ttt[fs] = get_throughput_time(t["report_base"], num_streams, fs)
         if not m.get("skip"):
-            for i in get_stream_range(num_streams, fs):
-                run(PY + ["ndstpu.harness.maintenance",
-                          l["warehouse_path"],
-                          d["data_path"] + f"_{i}",
-                          m["report_base"] + f"_{i}.csv"])
+            with _phase(f"maintenance_test_{fs}", phase_walls):
+                for i in get_stream_range(num_streams, fs):
+                    run(PY + ["ndstpu.harness.maintenance",
+                              l["warehouse_path"],
+                              d["data_path"] + f"_{i}",
+                              m["report_base"] + f"_{i}.csv"])
         tdm[fs] = get_maintenance_time(m["report_base"], num_streams, fs)
 
     qps = len(__import__("ndstpu.queries.streamgen",
@@ -271,6 +304,45 @@ def run_full_bench(yaml_params: dict) -> None:
     }
     print(metrics)
     write_metrics_report(mtr["metrics_report"], metrics)
+    write_hw_metrics(yaml_params, metrics, phase_walls)
+
+
+def write_hw_metrics(yaml_params: dict, metrics: dict,
+                     phase_walls: dict) -> str:
+    """Phase-level hardware-run artifact (docs/HW_METRICS_*.json):
+    driver phase walls + the composite metric + the power runner's
+    per-query attribution sidecar (written by ndstpu.harness.power next
+    to its time log when tracing is on).  Path from ``metrics:
+    hw_metrics`` in the config; defaults to ``hw_metrics.json`` next to
+    the metrics report."""
+    p = yaml_params["power_test"]
+    mtr = yaml_params["metrics"]
+    power_sidecar = p["report_file"] + ".metrics.json"
+    power_metrics = None
+    if os.path.exists(power_sidecar):
+        try:
+            with open(power_sidecar) as f:
+                power_metrics = json.load(f)
+        except Exception as e:  # artifact is best-effort, never fatal
+            print(f"WARNING: power metrics sidecar unreadable: {e}")
+    hw = {
+        "format": "ndstpu-hw-metrics-v1",
+        "scale_factor": yaml_params["data_gen"]["scale_factor"],
+        "engine": p.get("engine", "cpu"),
+        "num_streams": yaml_params["generate_query_stream"]["num_streams"],
+        "phases": phase_walls,
+        "summary": metrics,
+        "power": power_metrics,
+        "counters": obs.counters_snapshot(),
+        "gauges": obs.gauges_snapshot(),
+    }
+    hw_path = mtr.get("hw_metrics") or os.path.join(
+        os.path.dirname(mtr["metrics_report"]) or ".", "hw_metrics.json")
+    os.makedirs(os.path.dirname(hw_path) or ".", exist_ok=True)
+    with open(hw_path, "w") as f:
+        json.dump(hw, f, indent=2)
+    print(f"HW metrics artifact: {hw_path}")
+    return hw_path
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import csv
+import json
 import os
 import re
 import time
@@ -25,6 +26,7 @@ import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from ndstpu import obs
 from ndstpu.check import check_json_summary_folder, check_query_subset_exists
 from ndstpu.engine import columnar
 from ndstpu.engine.session import Session
@@ -175,6 +177,15 @@ def apply_engine_properties(engine_conf: Dict[str, str]) -> None:
             print(f"WARNING: engine property {k}={v} not applied: {e}")
 
 
+def _dir_file_count(path: Optional[str]) -> int:
+    """Recursive file count of the XLA persistent compile cache — the
+    before/after gauge that distinguishes a genuinely warm run (no new
+    cache entries) from one that recompiled behind preloaded records."""
+    if not path or not os.path.isdir(path):
+        return 0
+    return sum(len(files) for _, _, files in os.walk(path))
+
+
 def run_query_stream(args) -> None:
     total_start = time.time()
     execution_times = []
@@ -200,9 +211,10 @@ def run_query_stream(args) -> None:
 
     # catalog load == table registration (TempView analog)
     load_start = time.time()
-    catalog = loader.load_catalog(args.input_prefix,
-                                  use_decimal=not args.floats)
-    sess = Session(catalog, backend=args.engine)
+    with obs.span("load_catalog", cat="phase"):
+        catalog = loader.load_catalog(args.input_prefix,
+                                      use_decimal=not args.floats)
+        sess = Session(catalog, backend=args.engine)
     # distributed-engine knobs via the property channel (the analog of
     # spark.sql.shuffle.partitions etc. flowing from the template)
     if engine_conf.get("spmd.threshold_rows"):
@@ -216,8 +228,12 @@ def run_query_stream(args) -> None:
         # after the load-time row: preload re-plans every saved query and
         # must not be charged to table registration
         preload_start = time.time()
+        obs.set_gauge("harness.compile_records.present",
+                      1 if os.path.exists(args.compile_records) else 0)
         try:
-            n = sess.preload_compiled(args.compile_records)
+            with obs.span("preload_compile_records", cat="phase"):
+                n = sess.preload_compiled(args.compile_records)
+            obs.inc("harness.compile_records.preloaded", n)
             print(f"preloaded {n} compile records")
         except Exception as e:  # stale records must never kill the run
             print(f"WARNING: compile records not loaded: {e}")
@@ -310,7 +326,14 @@ def run_query_stream(args) -> None:
         if "err" in slot:
             raise slot["err"]
 
+    stream_name = os.path.splitext(
+        os.path.basename(args.query_stream_file))[0]
+    obs.set_gauge("xla.persistent_cache.files",
+                  _dir_file_count(args.xla_cache_dir))
     power_start = int(time.time())
+    stream_span = obs.span(stream_name, cat="stream", collect=True,
+                           engine=args.engine, n_queries=len(query_dict))
+    stream_span.__enter__()
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
         # abandoned-thread gate: give zombies a short grace window to
@@ -320,6 +343,7 @@ def run_query_stream(args) -> None:
             print(f"WARNING: abandoned query threads still running: "
                   f"{active_zombies} — device contention possible; "
                   f"captured warnings may belong to them")
+        xla_files_before = _dir_file_count(args.xla_cache_dir)
         q_report = BenchReport(engine_conf)
         # NOTE metric difference vs the reference: its concurrentGpuTasks
         # semaphore is acquired inside task execution, so queue wait is
@@ -334,7 +358,8 @@ def run_query_stream(args) -> None:
             wait_ms = int((time.time() - wait_start) * 1000)
         try:
             summary = q_report.report_on(run_guarded, q_content,
-                                         query_name)
+                                         query_name,
+                                         query_name=query_name)
         finally:
             if gate is not None:
                 gate.release()
@@ -342,6 +367,15 @@ def run_query_stream(args) -> None:
             summary["admissionWaitMs"] = wait_ms
         if active_zombies:
             summary["zombieQueries"] = active_zombies
+        if args.xla_cache_dir:
+            xla_files_after = _dir_file_count(args.xla_cache_dir)
+            obs.set_gauge("xla.persistent_cache.files", xla_files_after)
+            if xla_files_after > xla_files_before:
+                obs.inc("xla.persistent_cache.new_entries",
+                        xla_files_after - xla_files_before)
+            if summary.get("metrics"):
+                summary["metrics"][-1]["xla_cache_files"] = {
+                    "before": xla_files_before, "after": xla_files_after}
         print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
         execution_times.append((app_id, query_name,
                                 summary["queryTimes"][0]))
@@ -353,6 +387,7 @@ def run_query_stream(args) -> None:
             else:
                 prefix = os.path.join(args.json_summary_folder, "")
             q_report.write_summary(query_name, prefix=prefix)
+    stream_span.__exit__(None, None, None)
     power_end = int(time.time())
     power_elapse = int((power_end - power_start) * 1000)
     total_elapse = int((time.time() - total_start) * 1000)
@@ -382,6 +417,29 @@ def run_query_stream(args) -> None:
             w = csv.writer(f)
             w.writerow(header)
             w.writerows(execution_times)
+
+    if obs.enabled():
+        # one JSONL event log + one Perfetto-loadable Chrome trace per
+        # run, next to the time log (NDSTPU_TRACE_DIR overrides), plus a
+        # machine-readable metrics sidecar the bench driver aggregates
+        trace_dir = os.environ.get("NDSTPU_TRACE_DIR") or \
+            (os.path.dirname(args.time_log) or ".")
+        base = os.path.basename(args.time_log)
+        try:
+            paths = obs.export_run(trace_dir, base)
+            sidecar = args.time_log + ".metrics.json"
+            with open(sidecar, "w") as f:
+                json.dump(obs.run_metrics({
+                    "app_id": app_id,
+                    "engine": args.engine,
+                    "stream": stream_name,
+                    "power_elapse_ms": power_elapse,
+                    "total_elapse_ms": total_elapse,
+                }), f, indent=2)
+            print(f"====== Trace: {paths['jsonl']} | {paths['chrome']} "
+                  f"| {sidecar} ======")
+        except Exception as e:  # observability must never fail the run
+            print(f"WARNING: trace export failed: {e}")
 
 
 def build_parser() -> argparse.ArgumentParser:

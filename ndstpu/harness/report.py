@@ -21,6 +21,7 @@ import warnings
 from typing import Callable
 
 import ndstpu
+from ndstpu import obs
 
 
 class BenchReport:
@@ -41,7 +42,7 @@ class BenchReport:
             "queryTimes": [],
         }
 
-    def report_on(self, fn: Callable, *args):
+    def report_on(self, fn: Callable, *args, query_name: str = None):
         redacted = ("TOKEN", "SECRET", "PASSWORD")
         self.summary["env"]["envVars"] = {
             k: v for k, v in os.environ.items()
@@ -49,10 +50,14 @@ class BenchReport:
         self.summary["env"]["engineConf"] = self.engine_conf
         self.summary["env"]["engineVersion"] = ndstpu.__version__
         start_time = int(time.time() * 1000)
+        counters_before = obs.counters_snapshot()
+        qspan = obs.span(query_name or getattr(fn, "__name__", "query"),
+                         cat="query", collect=True)
         try:
             with warnings.catch_warnings(record=True) as caught:
                 warnings.simplefilter("always")
-                fn(*args)
+                with qspan:
+                    fn(*args)
             end_time = int(time.time() * 1000)
             if caught:
                 self.summary["queryStatus"].append(
@@ -72,6 +77,24 @@ class BenchReport:
         finally:
             self.summary["startTime"] = start_time
             self.summary["queryTimes"].append(end_time - start_time)
+            if obs.enabled():
+                b = qspan.buckets or {}
+                wall = qspan.wall_s
+                compile_s = round(b.get("compile_s", 0.0), 6)
+                execute_s = round(b.get("execute_s", 0.0), 6)
+                self.summary.setdefault("metrics", []).append({
+                    "query": query_name,
+                    "wall_s": round(wall, 6),
+                    "compile_s": compile_s,
+                    "execute_s": execute_s,
+                    "attributed_frac": round(
+                        (compile_s + execute_s) / wall, 4)
+                        if wall > 0 else 0.0,
+                    "mode": "cold"
+                        if compile_s > max(0.05 * wall, 1e-4) else "warm",
+                    "buckets": {k: round(v, 6) for k, v in b.items()},
+                    "counters": obs.counter_delta(counters_before),
+                })
         return self.summary
 
     def write_summary(self, query_name: str, prefix: str = "") -> str:

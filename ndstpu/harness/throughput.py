@@ -19,7 +19,10 @@ import os
 import subprocess
 import sys
 import tempfile
+import time
 from typing import List, Optional
+
+from ndstpu import obs
 
 
 def run_throughput(stream_ids: List[str], cmd_template: List[str],
@@ -33,13 +36,24 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
                    NDSTPU_ADMISSION_DIR=lock_dir)
     try:
         procs = []
+        starts = {}
         for sid in stream_ids:
             cmd = [arg.replace("{}", sid) for arg in cmd_template]
             print("launch:", " ".join(cmd))
-            procs.append(subprocess.Popen(cmd, env=env))
+            starts[sid] = time.time()
+            obs.inc("harness.throughput.streams_launched")
+            procs.append((sid, subprocess.Popen(cmd, env=env)))
         rc = 0
-        for p in procs:
+        for sid, p in procs:
             p.wait()
+            # stream lifetimes overlap, so a context-manager span cannot
+            # express them — record each with explicit timestamps (the
+            # per-query detail lives in each stream process's own trace)
+            obs.record(f"stream_{sid}", "stream", starts[sid],
+                       time.time() - starts[sid],
+                       returncode=p.returncode)
+            if p.returncode:
+                obs.inc("harness.throughput.streams_failed")
             rc = rc or p.returncode
         return rc
     finally:

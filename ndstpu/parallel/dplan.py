@@ -43,6 +43,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ndstpu import obs
 from ndstpu.engine import columnar, expr as ex, physical, plan as lp
 from ndstpu.engine.columnar import BOOL, FLOAT64, INT64, Column, Table
 from ndstpu.engine.jaxexec import (
@@ -319,6 +320,7 @@ class DistributedPlanExecutor:
         checked catalog versions are unchanged) and redo the host
         finalize + plan tail — the repeat-execution path for cached
         tpu-spmd queries (no re-trace, no re-compile, no host build)."""
+        obs.inc("engine.spmd.reexecs")
         if self._union_ctx is not None:
             return self._union_again()
         if getattr(self, "_scalar_ctx", None) is not None:
@@ -989,6 +991,12 @@ class DistributedPlanExecutor:
         if not self._prepared:
             # host-side join staging runs ONCE per plan: skew retries
             # re-enter only to re-trace with a larger bucket slack
+            with obs.span("spine_stage", cat="plan-node"):
+                self._run_spine_stage(row_head, agg)
+        return self._run_spine_traced(spine, agg, row_head)
+
+    def _run_spine_stage(self, row_head, agg) -> None:
+        if True:
             self._resolve_all(row_head)
             if agg is not None:
                 for _, e in agg.aggs + agg.group_by:
@@ -1012,6 +1020,8 @@ class DistributedPlanExecutor:
                         if isinstance(nd, ex.ColumnRef)}
             self._prepare(row_head)
             self._prepared = True
+
+    def _run_spine_traced(self, spine: lp.Plan, agg, row_head) -> Table:
         if self.fact is None:
             raise DistUnsupported("no sharded scan on spine")
         fact_table = self.catalog.get(self.fact.table)
@@ -1150,10 +1160,17 @@ class DistributedPlanExecutor:
         self._compiled_fn = jax.jit(sharded)
         self._dev_args = dev_args
         self._chunk_info = (chunked, rows_per, n, n_fact_args)
+        obs.inc("engine.spmd.traces")
         if not chunked:
-            out = jax.device_get(self._compiled_fn(*dev_args))
+            # jit is lazy: this first call pays shard_map trace + XLA
+            # compile, then runs — a mixed region, so it is left in the
+            # statement's execute self-time rather than a cost bucket
+            with obs.span("spine_trace_exec", cat="plan-node",
+                          n_args=n_args):
+                out = jax.device_get(self._compiled_fn(*dev_args))
             return self._post_spine(out)
-        return self._run_chunks()
+        with obs.span("spine_trace_exec", cat="plan-node", chunked=True):
+            return self._run_chunks()
 
     def _run_chunks(self):
         """Out-of-core execution: stream fact chunks through the one
@@ -1572,6 +1589,12 @@ class DistributedPlanExecutor:
         out_alive = slot_used & galive
 
         def gather(x):
+            # traced-collective instrument: counted once per compiled
+            # program (see exchange._note_collective)
+            obs.inc("exchange.all_gather.calls")
+            obs.inc("exchange.shuffle_bytes",
+                    int(x.size * x.dtype.itemsize
+                        * self.n_dev * (self.n_dev - 1)))
             return lax.all_gather(x, SHARD_AXIS).reshape(
                 (self.n_dev * cap,) + x.shape[1:])
 

@@ -21,7 +21,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ndstpu import obs
 from ndstpu.parallel.mesh import SHARD_AXIS
+
+
+def _note_collective(kind: str, bytes_est: int) -> None:
+    """Record one traced collective + its estimated global wire bytes.
+
+    These functions run inside ``shard_map`` tracing, so the counters
+    tick once per COMPILED PROGRAM (at trace time), not per execution —
+    they measure how much collective traffic a query's program commits
+    to, from static shapes.  ``exchange.shuffle_bytes`` is the
+    all-devices total for one execution of the traced op."""
+    obs.inc(f"exchange.{kind}.calls")
+    obs.inc("exchange.shuffle_bytes", int(bytes_est))
 
 
 def _mix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -92,6 +105,12 @@ def repartition_by_dest(cols: Dict[str, jnp.ndarray], dest: jnp.ndarray,
         buf = jnp.zeros((n_dev + 1, bucket_cap), arr.dtype)
         return buf.at[row, slot].set(arr[order])[:n_dev]
 
+    # each device exchanges an [n_dev, bucket_cap] buffer per column
+    # (+ the alive mask) with every peer: n_dev^2 * bucket_cap slots
+    _note_collective(
+        "all_to_all",
+        n_dev * n_dev * bucket_cap *
+        (sum(a.dtype.itemsize for a in cols.values()) + 1))
     sent_alive = jnp.zeros((n_dev + 1, bucket_cap), bool).at[
         row, slot].set(ok)[:n_dev]
     n_dropped = lax.psum(
@@ -111,6 +130,9 @@ def broadcast_gather(arr: jnp.ndarray, axis: str = SHARD_AXIS
                      ) -> jnp.ndarray:
     """Replicate all shards' rows on every device (broadcast join build
     side; analog of spark.sql.autoBroadcastJoinThreshold exchange)."""
+    n_dev = jax.device_count()  # upper bound: mesh may be a sub-mesh
+    _note_collective("all_gather",
+                     arr.size * arr.dtype.itemsize * n_dev * (n_dev - 1))
     return lax.all_gather(arr, axis, tiled=True)
 
 
@@ -120,6 +142,9 @@ def sharded_segment_sum(values: jnp.ndarray, segment_ids: jnp.ndarray,
     """Partial aggregation: local segment_sum, then cross-device psum.
     The group-key -> segment-id mapping must be device-agnostic (e.g. a
     dense dimension key), so partials line up slot-for-slot."""
+    n_dev = jax.device_count()  # upper bound: mesh may be a sub-mesh
+    _note_collective("psum",
+                     num_segments * values.dtype.itemsize * n_dev)
     partial = jax.ops.segment_sum(values, segment_ids,
                                   num_segments=num_segments)
     return lax.psum(partial, axis)

@@ -10,10 +10,14 @@ PINNED to the warmed bench corpus seed (bench_hw_sf1.yml `rngseed:`,
 the orchestrated form of the reference stream generator's explicit
 --rngseed), so the power phase (stream 0) replays the compiled TPU
 programs scripts/warm_corpus.py built.  Streams 1-4 combine the seed
-with their stream index, so throughput/maintenance still carry fresh
-per-stream parameter draws; those one-shot queries run the engine's
-eager discovery path (NDSTPU_WARM_REPLAY=0) — paying a 20-95 s XLA
-compile per query would never amortize inside a single execution.
+with their stream index, so throughput/maintenance carry deterministic
+per-stream draws (distinct per stream, identical across runs); those
+one-shot queries run the engine's eager discovery path
+(NDSTPU_WARM_REPLAY=0) — paying a 20-95 s XLA compile per query would
+never amortize inside a single execution.  Because the draws repeat
+across runs, throughput numbers are only cold when the persistent XLA
+cache starts empty: a rerun against a populated cache serves those
+same programs from disk.
 """
 from __future__ import annotations
 
@@ -39,17 +43,33 @@ def _read_csv(path: pathlib.Path):
 
 def main() -> int:
     t0 = time.time()
+    xla_cache = REPO / ".bench_cache" / "xla_cache_tpu"
     env = dict(os.environ,
                NDSTPU_WARM_REPLAY="0",
-               NDSTPU_XLA_CACHE_DIR=str(
-                   REPO / ".bench_cache" / "xla_cache_tpu"))
+               NDSTPU_XLA_CACHE_DIR=str(xla_cache))
     cfg = REPO / "ndstpu" / "harness" / "bench_hw_sf1.yml"
+    import yaml
+    with open(cfg) as f:
+        cfg_params = yaml.safe_load(f)
+    stream_cfg = cfg_params.get("generate_query_stream", {})
+    # the pin is a reproducibility deviation from spec 4.3.1 seed
+    # chaining — DERIVED from the config, not asserted, so an edited
+    # yml cannot silently invalidate the recorded claim
+    rngseed_pinned = "rngseed" in stream_cfg
+    if stream_cfg.get("rngseed") == "bench":
+        from ndstpu.queries.streamgen import BENCH_RNGSEED
+        rngseed_resolved = BENCH_RNGSEED
+    else:
+        rngseed_resolved = stream_cfg.get("rngseed")
     # the replay claim below must be derived, not asserted: if the warm
     # artifacts are absent (e.g. after an environment reset) the power
-    # phase silently pays full discovery and the committed artifact
-    # would otherwise still read as a warm steady-state run
+    # phase silently pays full discovery — and records alone are not
+    # enough: without a populated persistent XLA cache the warm-up
+    # replay still compiles every program from scratch
     records = REPO / ".bench_cache" / "plans_sf1.pkl"
     records_present = records.exists()
+    xla_cache_present = xla_cache.is_dir() and any(xla_cache.iterdir())
+    warm_artifacts = records_present and xla_cache_present
     r = subprocess.run(
         [sys.executable, "-m", "ndstpu.harness.bench", str(cfg)],
         env=env, cwd=str(REPO))
@@ -57,23 +77,42 @@ def main() -> int:
         "config": str(cfg.relative_to(REPO)),
         "exit_code": r.returncode,
         "wall_s": round(time.time() - t0, 1),
-        # the pin is a reproducibility deviation from spec 4.3.1 seed
-        # chaining — recorded so the artifact is not mistaken for a
-        # fresh-draw cold run (review finding, 2026-08-02)
-        "rngseed_pinned": True,
+        "rngseed_pinned": rngseed_pinned,
+        "rngseed_resolved": rngseed_resolved,
         "compile_records_present": records_present,
+        "xla_cache_present": xla_cache_present,
         "execution_strategy": (
-            "stream seed pinned to the warmed bench corpus seed "
-            "(bench_hw_sf1.yml rngseed: bench): the power phase "
+            ("stream seed pinned to the warmed bench corpus seed "
+             f"(bench_hw_sf1.yml rngseed, resolved {rngseed_resolved}): "
+             if rngseed_pinned else
+             "stream seed chained from the load end timestamp "
+             "(spec 4.3.1 — corpus differs from the warmed one): ")
+            + "the power phase "
             + ("replays compiled TPU programs"
-               if records_present else
-               "had NO compile records — it paid full discovery, "
-               "treat power numbers as cold")
-            + "; streams 1-4 draw fresh per-stream parameters and run "
+               if warm_artifacts and rngseed_pinned else
+               "lacked warm artifacts (records and/or XLA cache) — it "
+               "paid discovery/compile, treat power numbers as cold")
+            + "; streams 1-4 carry deterministic per-stream draws "
+            "(distinct per stream, identical across runs) and run "
             "one-shot eager discovery (NDSTPU_WARM_REPLAY=0) because "
             "a per-query XLA compile cannot amortize in a single "
-            "execution"),
+            "execution; their numbers are cold only against an empty "
+            "XLA cache"),
     }
+    # tracer ground truth (power sidecar): the per-query compile_s the
+    # engine actually measured adjudicates the warm-replay claim above
+    sidecar = RUN / "power_time.csv.metrics.json"
+    if sidecar.exists():
+        try:
+            pm = json.loads(sidecar.read_text())
+            totals = pm.get("totals", {})
+            art["power_attribution"] = totals
+            art["power_cold_queries"] = totals.get("cold_queries")
+            art["power_warm_replay_measured"] = (
+                totals.get("n_queries", 0) > 0
+                and totals.get("cold_queries", 1) == 0)
+        except (ValueError, OSError) as e:
+            art["power_attribution_error"] = str(e)
     metrics = _read_csv(RUN / "metrics.csv")
     if metrics:
         art["metrics"] = {row[0]: row[1] for row in metrics if len(row) == 2}

@@ -1,0 +1,506 @@
+"""Observability: span tracer, cost attribution, exports, instruments.
+
+Covers the tentpole contracts (docs/OBSERVABILITY.md): span
+nesting/ordering invariants, bucket self-time accounting (buckets sum
+to collector wall within tolerance), cache hit/miss counters across a
+scripted cold-then-warm session, Chrome-trace validity (matched B/E
+pairs), the NDSTPU_TRACE=0 no-op path leaving query output
+byte-identical, the BenchReport ``metrics`` block, and the >=90%
+per-query attribution acceptance bar over a multi-query power-style
+stream.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from ndstpu import obs
+from ndstpu.engine import columnar
+from ndstpu.engine.columnar import INT32, Column
+from ndstpu.engine.session import Session
+from ndstpu.io.loader import Catalog
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracer():
+    """Each test gets its own enabled tracer; the global is restored to
+    env-default afterwards so other test modules are unaffected."""
+    obs.reset(enabled=True)
+    yield obs.tracer()
+    obs.reset()
+
+
+def col_i32(vals):
+    return Column(np.array(vals, dtype=np.int32), INT32, None)
+
+
+def tiny_catalog() -> Catalog:
+    cat = Catalog()
+    cat.register("item", columnar.Table({
+        "i_item_sk": col_i32(list(range(1, 21))),
+        "i_brand_id": col_i32([i % 3 for i in range(20)]),
+    }))
+    cat.register("sales", columnar.Table({
+        "s_item_sk": col_i32([i % 20 + 1 for i in range(60)]),
+        "s_qty": col_i32([i % 7 for i in range(60)]),
+        "s_price": col_i32([100 + i for i in range(60)]),
+    }))
+    return cat
+
+
+FIVE_QUERIES = [
+    "select s_item_sk, sum(s_qty) as q from sales group by s_item_sk "
+    "order by q desc limit 5",
+    "select i_brand_id, count(*) as n from item group by i_brand_id",
+    "select sum(s_price) as total from sales where s_qty > 2",
+    "select i_brand_id, sum(s_qty) as q from sales, item "
+    "where s_item_sk = i_item_sk group by i_brand_id order by i_brand_id",
+    "select avg(s_price) as p, max(s_qty) as m from sales",
+]
+
+
+# -- span model ---------------------------------------------------------------
+
+
+def test_span_nesting_and_ordering(fresh_tracer):
+    t = fresh_tracer
+    with t.span("outer", cat="query", collect=True):
+        with t.span("mid", cat="plan-node"):
+            with t.span("inner", cat="plan-node"):
+                pass
+        with t.span("sibling", cat="plan-node"):
+            pass
+    names = [e["name"] for e in t.events]
+    # events append in END order: children before parents, siblings in
+    # completion order
+    assert names == ["inner", "mid", "sibling", "outer"]
+    depth = {e["name"]: e["depth"] for e in t.events}
+    assert depth == {"outer": 0, "mid": 1, "inner": 2, "sibling": 1}
+    seq = {e["name"]: e["seq"] for e in t.events}
+    # seq is assigned at OPEN: parents before their children
+    assert seq["outer"] < seq["mid"] < seq["inner"] < seq["sibling"]
+    # timestamps nest: children start no earlier, end no later
+    ev = {e["name"]: e for e in t.events}
+    for child, parent in (("mid", "outer"), ("inner", "mid"),
+                          ("sibling", "outer")):
+        c, p = ev[child], ev[parent]
+        assert c["ts_epoch_s"] >= p["ts_epoch_s"] - 1e-6
+        assert (c["ts_epoch_s"] + c["wall_s"]
+                <= p["ts_epoch_s"] + p["wall_s"] + 1e-6)
+
+
+def test_buckets_sum_to_collector_wall(fresh_tracer):
+    """Self-time accounting: nested bucketed spans never double count,
+    and a fully-bucketed tree's totals equal the collector wall."""
+    import time
+    t = fresh_tracer
+    with t.span("q", cat="query", collect=True) as q:
+        with t.span("stmt", cat="plan-node", bucket="execute_s"):
+            with t.span("discover", cat="plan-node", bucket="compile_s"):
+                time.sleep(0.02)
+            with t.span("build", cat="plan-node", bucket="compile_s"):
+                time.sleep(0.01)
+            time.sleep(0.02)
+    total = sum(q.buckets.values())
+    assert q.buckets["compile_s"] >= 0.03 - 1e-3
+    assert q.buckets["execute_s"] >= 0.02 - 1e-3
+    # buckets cover the whole wall here (everything inside is bucketed)
+    assert total <= q.wall_s + 1e-6
+    assert total >= 0.95 * q.wall_s
+
+
+def test_transparent_span_propagates_bucketed_time(fresh_tracer):
+    """A non-bucketed span between two bucketed ones must still
+    subtract its bucketed children from the outer span's self time."""
+    import time
+    t = fresh_tracer
+    with t.span("q", cat="query", collect=True) as q:
+        with t.span("outer", cat="plan-node", bucket="execute_s"):
+            with t.span("transparent", cat="plan-node"):
+                with t.span("inner", cat="plan-node",
+                            bucket="compile_s"):
+                    time.sleep(0.02)
+    # compile time is NOT also counted as execute self time
+    assert q.buckets["compile_s"] >= 0.02 - 1e-3
+    assert q.buckets.get("execute_s", 0.0) < 0.02
+    assert sum(q.buckets.values()) <= q.wall_s + 1e-6
+
+
+def test_collector_rollup_to_stream(fresh_tracer):
+    t = fresh_tracer
+    with t.span("stream", cat="stream", collect=True) as st:
+        for qn in ("q1", "q2"):
+            with t.span(qn, cat="query", collect=True):
+                with t.span("work", cat="plan-node",
+                            bucket="execute_s"):
+                    pass
+    assert st.buckets.get("execute_s", 0.0) > 0.0
+    assert len(t.query_summaries()) == 2
+
+
+def test_cross_thread_fallback_collector(fresh_tracer):
+    """A span opened on a worker thread with an empty stack attributes
+    to the process's open collector (the power watchdog pattern)."""
+    import threading
+    t = fresh_tracer
+    with t.span("q", cat="query", collect=True) as q:
+        def work():
+            with t.span("engine_work", cat="plan-node",
+                        bucket="execute_s"):
+                pass
+        th = threading.Thread(target=work)
+        th.start()
+        th.join()
+    assert q.buckets.get("execute_s", 0.0) > 0.0
+
+
+def test_disabled_tracer_is_noop(monkeypatch):
+    from ndstpu.obs.trace import env_enabled
+    monkeypatch.setenv("NDSTPU_TRACE", "0")
+    assert not env_enabled()
+    monkeypatch.setenv("NDSTPU_TRACE", "false")
+    assert not env_enabled()
+    monkeypatch.delenv("NDSTPU_TRACE")
+    assert env_enabled()
+    t = obs.reset(enabled=False)
+    with obs.span("x", cat="query", collect=True) as sp:
+        obs.inc("some.counter")
+        obs.set_gauge("some.gauge", 3)
+    assert sp is obs.NULL_SPAN
+    assert t.events == [] and t.counters == {} and t.gauges == {}
+
+
+# -- engine integration -------------------------------------------------------
+
+
+def test_cache_counters_cold_then_warm(fresh_tracer):
+    """A scripted cold-then-replay session: the first run misses every
+    cache and discovers; the replay hits the compiled-plan cache and
+    classifies warm with ~zero compile seconds."""
+    sess = Session(tiny_catalog(), backend="tpu")
+    sql = FIVE_QUERIES[0]
+
+    with obs.span("cold", cat="query", collect=True):
+        sess.sql(sql).to_rows()
+    cold = obs.counters_snapshot()
+    assert cold.get("engine.cache.compiled.miss", 0) == 1
+    assert cold.get("engine.discoveries", 0) == 1
+    assert cold.get("engine.cache.compiled.hit", 0) == 0
+
+    with obs.span("warm", cat="query", collect=True):
+        sess.sql(sql).to_rows()
+    delta = obs.counter_delta(cold)
+    assert delta.get("engine.cache.compiled.hit", 0) == 1
+    assert "engine.discoveries" not in delta
+
+    summaries = obs.tracer().query_summaries()
+    assert [s["query"] for s in summaries] == ["cold", "warm"]
+    assert summaries[0]["mode"] == "cold"
+    assert summaries[1]["mode"] == "warm"
+    assert summaries[1]["compile_s"] <= 0.05 * summaries[1]["wall_s"] + 1e-4
+
+
+def test_trace_off_query_output_identical(fresh_tracer):
+    """NDSTPU_TRACE=0 must not perturb results: bytes out are identical
+    with tracing on and off."""
+    sql = FIVE_QUERIES[3]
+    sess_on = Session(tiny_catalog(), backend="tpu")
+    obs.reset(enabled=True)
+    rows_on = sess_on.sql(sql).to_rows()
+    assert obs.tracer().counters  # tracing actually observed the run
+
+    obs.reset(enabled=False)
+    sess_off = Session(tiny_catalog(), backend="tpu")
+    rows_off = sess_off.sql(sql).to_rows()
+    assert not obs.tracer().counters
+    assert repr(rows_on) == repr(rows_off)
+    assert json.dumps(rows_on, default=str) == \
+        json.dumps(rows_off, default=str)
+
+
+def test_power_style_attribution_five_queries(fresh_tracer):
+    """Acceptance bar: per-query compile_s + execute_s accounts for
+    >=90% of measured wall over a 5-query stream, cold and warm."""
+    sess = Session(tiny_catalog(), backend="tpu")
+    for rnd in ("cold", "warm"):
+        for i, sql in enumerate(FIVE_QUERIES):
+            with obs.span(f"query{i}_{rnd}", cat="query", collect=True):
+                r = sess.sql(sql)
+                if r is not None:
+                    r.to_rows()
+    summaries = obs.tracer().query_summaries()
+    assert len(summaries) == 10
+    for s in summaries:
+        assert s["attributed_frac"] >= 0.9, s
+    cold = [s for s in summaries if s["query"].endswith("_cold")]
+    warm = [s for s in summaries if s["query"].endswith("_warm")]
+    assert all(s["mode"] == "cold" for s in cold)
+    assert all(s["mode"] == "warm" for s in warm)
+    # cache counters separate the rounds: every query discovered once
+    c = obs.counters_snapshot()
+    assert c["engine.discoveries"] == len(FIVE_QUERIES)
+    assert c["engine.cache.compiled.hit"] >= len(FIVE_QUERIES)
+
+
+# -- exports ------------------------------------------------------------------
+
+
+def _populated_tracer():
+    t = obs.tracer()
+    with t.span("stream", cat="stream", collect=True):
+        with t.span("q1", cat="query", collect=True):
+            with t.span("work", cat="plan-node", bucket="execute_s"):
+                pass
+    t.inc("engine.cache.compiled.miss")
+    t.set_gauge("xla.persistent_cache.files", 4)
+    t.record("stream_2", "stream", t.t0_epoch, 0.5, returncode=0)
+    return t
+
+
+def test_jsonl_export_roundtrip(tmp_path, fresh_tracer):
+    _populated_tracer()
+    path = obs.export_jsonl(str(tmp_path / "run.trace.jsonl"))
+    lines = [json.loads(ln) for ln in
+             open(path).read().splitlines()]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["format"] == "ndstpu-trace-v1"
+    spans = [ln for ln in lines if ln["type"] == "span"]
+    assert {"work", "q1", "stream", "stream_2"} <= \
+        {s["name"] for s in spans}
+    q1 = next(s for s in spans if s["name"] == "q1")
+    assert q1["collect"] and "execute_s" in q1["buckets"]
+    counters = next(ln for ln in lines if ln["type"] == "counters")
+    assert counters["counters"]["engine.cache.compiled.miss"] == 1
+    gauges = next(ln for ln in lines if ln["type"] == "gauges")
+    assert gauges["gauges"]["xla.persistent_cache.files"] == 4
+
+
+def test_chrome_trace_valid_and_balanced(tmp_path, fresh_tracer):
+    _populated_tracer()
+    path = obs.export_chrome(str(tmp_path / "run.trace.json"))
+    doc = json.load(open(path))  # must be valid JSON
+    evs = doc["traceEvents"]
+    by_name: dict = {}
+    for e in evs:
+        assert e["ph"] in ("B", "E")
+        by_name.setdefault(e["name"], []).append(e["ph"])
+    for name, phs in by_name.items():
+        assert phs.count("B") == phs.count("E"), name
+    # timestamps are non-decreasing (Perfetto requirement per track)
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    # nesting survives: q1 opens after stream opens, closes before
+    opens = {e["name"]: e["ts"] for e in evs if e["ph"] == "B"}
+    closes = {e["name"]: e["ts"] for e in evs if e["ph"] == "E"}
+    assert opens["stream"] <= opens["q1"] <= closes["q1"] \
+        <= closes["stream"]
+
+
+def test_run_metrics_and_export_run(tmp_path, fresh_tracer):
+    _populated_tracer()
+    m = obs.run_metrics({"app_id": "x"})
+    assert m["totals"]["n_queries"] == 1
+    assert m["app_id"] == "x"
+    assert m["counters"]["engine.cache.compiled.miss"] == 1
+    paths = obs.export_run(str(tmp_path), "power_time.csv")
+    assert paths["jsonl"].endswith("power_time.csv.trace.jsonl")
+    assert paths["chrome"].endswith("power_time.csv.trace.json")
+    for p in paths.values():
+        assert json is not None and open(p).read()
+
+
+# -- harness integration ------------------------------------------------------
+
+
+def test_bench_report_metrics_block(fresh_tracer):
+    from ndstpu.harness.report import BenchReport
+    sess = Session(tiny_catalog(), backend="tpu")
+    sql = FIVE_QUERIES[2]
+
+    def run(q):
+        sess.sql(q).to_rows()
+
+    rep = BenchReport({"engine": "tpu"})
+    summary = rep.report_on(run, sql, query_name="query42")
+    assert summary["queryStatus"] == ["Completed"]
+    blk = summary["metrics"][0]
+    assert blk["query"] == "query42"
+    assert blk["mode"] == "cold"
+    assert blk["attributed_frac"] >= 0.9
+    assert blk["counters"].get("engine.cache.compiled.miss") == 1
+    assert blk["wall_s"] >= blk["compile_s"] + blk["execute_s"] - 1e-6
+
+    rep2 = BenchReport({"engine": "tpu"})
+    s2 = rep2.report_on(run, sql, query_name="query42")
+    assert s2["metrics"][0]["mode"] == "warm"
+    assert s2["metrics"][0]["counters"].get(
+        "engine.cache.compiled.hit") == 1
+
+
+def test_bench_report_metrics_on_failure(fresh_tracer):
+    from ndstpu.harness.report import BenchReport
+
+    def boom():
+        raise RuntimeError("no")
+
+    rep = BenchReport({})
+    summary = rep.report_on(boom, query_name="qx")
+    assert summary["queryStatus"] == ["Failed"]
+    # the metrics block still exists and the span recorded the error
+    assert summary["metrics"][0]["query"] == "qx"
+    ev = [e for e in obs.tracer().events if e["name"] == "qx"]
+    assert ev and ev[0]["args"].get("error") == "RuntimeError"
+
+
+def test_report_disabled_tracer_no_metrics_block():
+    from ndstpu.harness.report import BenchReport
+    obs.reset(enabled=False)
+    try:
+        rep = BenchReport({})
+        summary = rep.report_on(lambda: None, query_name="q")
+        assert "metrics" not in summary
+    finally:
+        obs.reset()
+
+
+def test_hw_metrics_artifact(tmp_path, fresh_tracer):
+    from ndstpu.harness.bench import write_hw_metrics
+    sidecar_data = {"totals": {"n_queries": 2, "cold_queries": 0}}
+    report_file = tmp_path / "power.csv"
+    (tmp_path / "power.csv.metrics.json").write_text(
+        json.dumps(sidecar_data))
+    params = {
+        "data_gen": {"scale_factor": 1},
+        "generate_query_stream": {"num_streams": 5},
+        "power_test": {"engine": "tpu",
+                       "report_file": str(report_file)},
+        "metrics": {"metrics_report": str(tmp_path / "metrics.csv"),
+                    "hw_metrics": str(tmp_path / "hw.json")},
+    }
+    path = write_hw_metrics(params, {"metric": 123},
+                            {"power_test": 1.5})
+    hw = json.load(open(path))
+    assert hw["format"] == "ndstpu-hw-metrics-v1"
+    assert hw["phases"]["power_test"] == 1.5
+    assert hw["summary"]["metric"] == 123
+    assert hw["power"]["totals"]["cold_queries"] == 0
+
+
+def test_hw_metrics_default_path(tmp_path, fresh_tracer):
+    from ndstpu.harness.bench import write_hw_metrics
+    params = {
+        "data_gen": {"scale_factor": 1},
+        "generate_query_stream": {"num_streams": 3},
+        "power_test": {"report_file": str(tmp_path / "p.csv")},
+        "metrics": {"metrics_report": str(tmp_path / "metrics.csv")},
+    }
+    path = write_hw_metrics(params, {}, {})
+    assert path == str(tmp_path / "hw_metrics.json")
+    assert json.load(open(path))["power"] is None
+
+
+def test_power_run_emits_traces_and_sidecar(tmp_path, monkeypatch,
+                                            fresh_tracer):
+    """Acceptance shape: a power run over 5 queries produces the JSONL
+    trace, the Chrome trace, and the metrics sidecar whose per-query
+    compile_s + execute_s accounts for >=90% of wall, with cache
+    counters distinguishing the cold run."""
+    import argparse
+
+    from ndstpu.harness import power
+    from ndstpu.io import loader
+
+    stream = tmp_path / "query_0.sql"
+    stream.write_text("".join(
+        f"-- start query {i + 1} in stream 0 using template "
+        f"query{i + 1}.tpl\n{sql};\n"
+        for i, sql in enumerate(FIVE_QUERIES)))
+    monkeypatch.setattr(loader, "load_catalog",
+                        lambda prefix, use_decimal=True: tiny_catalog())
+    xla_dir = tmp_path / "xla"
+    xla_dir.mkdir()
+    (xla_dir / "seeded_entry").write_text("x")
+    args = argparse.Namespace(
+        query_stream_file=str(stream), input_prefix=str(tmp_path),
+        time_log=str(tmp_path / "power_time.csv"),
+        input_format="parquet", engine="tpu", output_prefix=None,
+        output_format="parquet", property_file=None,
+        json_summary_folder=str(tmp_path / "json"), sub_queries=None,
+        extra_time_log=None, xla_cache_dir=str(xla_dir),
+        compile_records=None, floats=True)
+    power.run_query_stream(args)
+
+    sidecar = json.load(open(str(tmp_path / "power_time.csv.metrics.json")))
+    assert sidecar["totals"]["n_queries"] == len(FIVE_QUERIES)
+    assert sidecar["totals"]["attributed_frac"] >= 0.9
+    assert sidecar["totals"]["cold_queries"] == len(FIVE_QUERIES)
+    for q in sidecar["queries"]:
+        assert q["attributed_frac"] >= 0.9, q
+    c = sidecar["counters"]
+    assert c["engine.cache.compiled.miss"] == len(FIVE_QUERIES)
+    assert sidecar["gauges"]["xla.persistent_cache.files"] == 1
+
+    jsonl = (tmp_path / "power_time.csv.trace.jsonl").read_text()
+    spans = [json.loads(ln) for ln in jsonl.splitlines()
+             if json.loads(ln)["type"] == "span"]
+    assert sum(1 for s in spans if s["cat"] == "query") == \
+        len(FIVE_QUERIES)
+    assert any(s["cat"] == "stream" for s in spans)
+
+    chrome = json.load(open(str(tmp_path / "power_time.csv.trace.json")))
+    phs = [e["ph"] for e in chrome["traceEvents"]]
+    assert phs.count("B") == phs.count("E") > 0
+
+    # BenchReport summaries carry the per-query metrics block; the
+    # filename contract is unchanged
+    summaries = list((tmp_path / "json").glob("-query1-*.json"))
+    assert len(summaries) == 1
+    s = json.load(open(str(summaries[0])))
+    assert s["metrics"][0]["mode"] == "cold"
+    assert s["metrics"][0]["xla_cache_files"] == {"before": 1, "after": 1}
+
+
+# -- exchange instruments -----------------------------------------------------
+
+
+def test_exchange_collective_counters(fresh_tracer):
+    """Counters tick at trace time with static byte estimates (the
+    documented per-compiled-program semantics)."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ndstpu.parallel import exchange
+    from ndstpu.parallel.mesh import SHARD_AXIS, make_mesh
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = make_mesh(n_dev)
+
+    def body(x):
+        return exchange.broadcast_gather(x)
+
+    try:  # replication-check kwarg was renamed across jax versions
+        fn = shard_map(body, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+                       out_specs=P(), check_vma=False)
+    except TypeError:
+        fn = shard_map(body, mesh=mesh, in_specs=(P(SHARD_AXIS),),
+                       out_specs=P(), check_rep=False)
+    x = jnp.arange(n_dev * 4, dtype=jnp.int32)
+    before = obs.counters_snapshot()
+    jax.jit(fn)(x)
+    delta = obs.counter_delta(before)
+    assert delta.get("exchange.all_gather.calls") == 1
+    # global wire bytes from static PER-SHARD shapes: every device
+    # sends its local shard (size/n_dev elements) to n_dev-1 peers
+    local = x.size // n_dev
+    assert delta.get("exchange.shuffle_bytes") == \
+        local * 4 * n_dev * (n_dev - 1)
