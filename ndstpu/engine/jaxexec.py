@@ -82,6 +82,11 @@ _ORD_DEAD32 = np.int32(2 ** 30 + 1)   # order keys: dead strictly last
 _NARROW_LIM = 2 ** 30                 # |value| bound for int32 keys
 _MIN_CAPACITY = 256
 
+# Engine default for NDSTPU_GROUPBY.  Module-level and literal on
+# purpose: obs/artifact_lint.py parses it from source (no jax import)
+# to cross-check docs/*.json artifacts that pin `engine_defaults`.
+GROUPBY_DEFAULT = "pallas"
+
 
 def size_class(n: int) -> int:
     """Smallest power-of-two capacity >= n (bounded recompilation)."""
@@ -1283,7 +1288,8 @@ class JaxExecutor:
         # passes) keeps the scatter path unless NDSTPU_GROUPBY=pallas
         # is set explicitly (tests use that for interpreter coverage).
         import os as _os
-        self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY", "pallas")
+        self.groupby_mode = _os.environ.get("NDSTPU_GROUPBY",
+                                            GROUPBY_DEFAULT)
         self._groupby_explicit = "NDSTPU_GROUPBY" in _os.environ
         self.groupby_domain_cap = int(
             _os.environ.get("NDSTPU_GROUPBY_DOMAIN", str(1 << 21)))

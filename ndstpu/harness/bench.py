@@ -34,15 +34,29 @@ PY = [sys.executable, "-m"]
 
 
 @contextmanager
-def _phase(name: str, walls: dict):
+def _phase(name: str, walls: dict, budget_s=None):
     """Time one bench phase: a tracer span (cat='phase') plus a wall
     entry for the HW metrics artifact.  Phases run as subprocesses, so
     per-query spans live in the power runner's own trace; the driver
-    records the phase envelope and stitches the power sidecar in."""
+    records the phase envelope and stitches the power sidecar in.
+    ``budget_s`` (per-phase ``budget_s:`` in the YAML) makes the
+    deadline visible: a start heartbeat, and an explicit overrun line +
+    counter when the phase blows its budget — never a silent burn."""
     t0 = time.time()
-    with obs.span(name, cat="phase"):
+    if budget_s:
+        print(f"[heartbeat] phase {name} start budget={budget_s:g}s")
+    with obs.span(name, cat="phase", budget_s=budget_s):
         yield
-    walls[name] = round(time.time() - t0, 3)
+    wall = time.time() - t0
+    walls[name] = round(wall, 3)
+    if budget_s:
+        if wall > budget_s:
+            print(f"[budget] phase {name} OVERRAN: {wall:.1f}s > "
+                  f"{budget_s:g}s budget (+{wall - budget_s:.1f}s)")
+            obs.inc("harness.budget.phase_overruns")
+        else:
+            print(f"[heartbeat] phase {name} done {wall:.1f}s of "
+                  f"{budget_s:g}s budget")
 
 
 def round_up_to_nearest_10_percent(num: float) -> float:
@@ -190,10 +204,22 @@ def run_full_bench(yaml_params: dict) -> None:
     num_streams = int(g["num_streams"])
     sq = max(len(get_stream_range(num_streams, 1)), 1)
     phase_walls: dict = {}
+    obs_cfg = yaml_params.get("observability") or {}
+    ledger_path = obs_cfg.get("ledger")
+    if ledger_path:
+        ledger_path = os.path.abspath(ledger_path)
+
+    # seed policy: a pinned `rngseed:` breaks spec 4.3.1's unconditional
+    # chaining (reference nds_bench.py:413-414 always chains from the
+    # load end timestamp).  Publish which policy this run used so
+    # report.py / the artifacts can carry the non-compliance flag.
+    seed_pinned = g.get("rngseed") is not None
+    os.environ["NDSTPU_SEED_POLICY"] = \
+        "pinned" if seed_pinned else "chained"
 
     # 1. data generation (+ per-stream refresh sets)
     if not d.get("skip"):
-        with _phase("data_gen", phase_walls):
+        with _phase("data_gen", phase_walls, d.get("budget_s")):
             run(PY + ["ndstpu.datagen.driver", "local", sf,
                       str(d["parallel"]), d["data_path"],
                       "--overwrite_output"])
@@ -204,7 +230,7 @@ def run_full_bench(yaml_params: dict) -> None:
 
     # 2. load test
     if not l.get("skip"):
-        with _phase("load_test", phase_walls):
+        with _phase("load_test", phase_walls, l.get("budget_s")):
             run(PY + ["ndstpu.io.transcode",
                       "--input_prefix", d["data_path"],
                       "--output_prefix", l["warehouse_path"],
@@ -216,7 +242,8 @@ def run_full_bench(yaml_params: dict) -> None:
     # 3. query streams (RNGSEED = load end timestamp, spec 4.3.1, or a
     #    pinned `rngseed:` override — see resolve_stream_rngseed)
     if not g.get("skip"):
-        with _phase("generate_query_stream", phase_walls):
+        with _phase("generate_query_stream", phase_walls,
+                    g.get("budget_s")):
             rngseed = resolve_stream_rngseed(g, l["report_file"])
             cmd = PY + ["ndstpu.queries.streamgen",
                         "--output_dir", g["stream_output_path"],
@@ -225,10 +252,14 @@ def run_full_bench(yaml_params: dict) -> None:
             if g.get("template_dir"):
                 cmd += ["--template_dir", g["template_dir"]]
             run(cmd)
+    try:
+        run_seed = resolve_stream_rngseed(g, l["report_file"])
+    except Exception:
+        run_seed = "unknown"
 
     # 4. power test
     if not p.get("skip"):
-        with _phase("power_test", phase_walls):
+        with _phase("power_test", phase_walls, p.get("budget_s")):
             if p.get("json_summary_folder"):
                 import shutil
                 shutil.rmtree(p["json_summary_folder"], ignore_errors=True)
@@ -236,7 +267,13 @@ def run_full_bench(yaml_params: dict) -> None:
                         os.path.join(g["stream_output_path"],
                                      "query_0.sql"),
                         l["warehouse_path"], p["report_file"],
-                        "--engine", p.get("engine", "cpu")]
+                        "--engine", p.get("engine", "cpu"),
+                        "--scale_factor", sf,
+                        "--run_seed", run_seed]
+            if p.get("budget_s"):
+                cmd += ["--budget_s", str(p["budget_s"])]
+            if ledger_path:
+                cmd += ["--ledger", ledger_path]
             if p.get("json_summary_folder"):
                 cmd += ["--json_summary_folder", p["json_summary_folder"]]
             if p.get("output_prefix"):
@@ -260,7 +297,8 @@ def run_full_bench(yaml_params: dict) -> None:
     ttt, tdm = {}, {}
     for fs in (1, 2):
         if not t.get("skip"):
-            with _phase(f"throughput_test_{fs}", phase_walls):
+            with _phase(f"throughput_test_{fs}", phase_walls,
+                        t.get("budget_s")):
                 ids = ",".join(str(x) for x in
                                get_stream_range(num_streams, fs))
                 tcmd = PY + ["ndstpu.harness.throughput", ids]
@@ -268,18 +306,31 @@ def run_full_bench(yaml_params: dict) -> None:
                     # device admission: at most N streams on the chip at
                     # a time (the concurrentGpuTasks analog)
                     tcmd += ["--concurrent", str(t["concurrent"])]
+                if t.get("budget_s"):
+                    tcmd += ["--budget_s", str(t["budget_s"])]
+                # overlap evidence artifact: proves the streams really
+                # ran concurrently under the admission cap
+                overlap = t.get("overlap_report") or \
+                    t["report_base"] + f"_overlap_{fs}.json"
+                tcmd += ["--overlap_report",
+                         overlap.replace("{}", str(fs))]
                 pcmd = PY + ["ndstpu.harness.power",
                              os.path.join(g["stream_output_path"],
                                           "query_{}.sql"),
                              l["warehouse_path"],
                              t["report_base"] + "_{}.csv",
-                             "--engine", p.get("engine", "cpu")]
+                             "--engine", p.get("engine", "cpu"),
+                             "--scale_factor", sf,
+                             "--run_seed", run_seed]
+                if ledger_path:
+                    pcmd += ["--ledger", ledger_path]
                 if p.get("compile_records"):
                     pcmd += ["--compile_records", p["compile_records"]]
                 run(tcmd + ["--"] + pcmd)
         ttt[fs] = get_throughput_time(t["report_base"], num_streams, fs)
         if not m.get("skip"):
-            with _phase(f"maintenance_test_{fs}", phase_walls):
+            with _phase(f"maintenance_test_{fs}", phase_walls,
+                        m.get("budget_s")):
                 for i in get_stream_range(num_streams, fs):
                     run(PY + ["ndstpu.harness.maintenance",
                               l["warehouse_path"],
@@ -325,12 +376,25 @@ def write_hw_metrics(yaml_params: dict, metrics: dict,
                 power_metrics = json.load(f)
         except Exception as e:  # artifact is best-effort, never fatal
             print(f"WARNING: power metrics sidecar unreadable: {e}")
+    g = yaml_params["generate_query_stream"]
+    seed_pinned = g.get("rngseed") is not None
+    phase_budgets = {
+        ph: (yaml_params.get(ph) or {}).get("budget_s")
+        for ph in ("data_gen", "load_test", "generate_query_stream",
+                   "power_test", "throughput_test", "maintenance_test")
+        if (yaml_params.get(ph) or {}).get("budget_s")}
     hw = {
         "format": "ndstpu-hw-metrics-v1",
         "scale_factor": yaml_params["data_gen"]["scale_factor"],
         "engine": p.get("engine", "cpu"),
         "num_streams": yaml_params["generate_query_stream"]["num_streams"],
         "phases": phase_walls,
+        "phase_budgets": phase_budgets,
+        "seed_policy": "pinned" if seed_pinned else "chained",
+        # spec 4.3.1 chains RNGSEED from the load end timestamp
+        # unconditionally (reference nds_bench.py:413-414); a pinned
+        # seed is a deliberate cache-warm trade and the artifact says so
+        "spec_compliant_seed": not seed_pinned,
         "summary": metrics,
         "power": power_metrics,
         "counters": obs.counters_snapshot(),

@@ -30,8 +30,11 @@ from ndstpu import obs
 from ndstpu.check import check_json_summary_folder, check_query_subset_exists
 from ndstpu.engine import columnar
 from ndstpu.engine.session import Session
+from ndstpu.harness import progress
 from ndstpu.harness.report import BenchReport
 from ndstpu.io import loader
+from ndstpu.obs import ledger as ledger_mod
+from ndstpu.obs import sentinel
 
 
 # One `-- start query N in stream M using template queryX.tpl` marker
@@ -330,11 +333,52 @@ def run_query_stream(args) -> None:
         os.path.basename(args.query_stream_file))[0]
     obs.set_gauge("xla.persistent_cache.files",
                   _dir_file_count(args.xla_cache_dir))
+
+    # -- run ledger + budget heartbeat (docs/OBSERVABILITY.md) --------
+    # priors feed the per-query ETA and the cheapest-first deadline
+    # degradation; the ledger itself is appended to after the stream.
+    # getattr: callers that build a Namespace by hand (tests, older
+    # drivers) predate these flags
+    run_scale_factor = getattr(args, "scale_factor", "unknown")
+    run_seed = getattr(args, "run_seed", "unknown")
+    led = None
+    ledger_path = getattr(args, "ledger", None)
+    if ledger_path is None:
+        ledger_path = ledger_mod.default_path()
+    if ledger_path and ledger_path.lower() != "none":
+        try:
+            led = ledger_mod.Ledger(ledger_path)
+        except Exception as e:  # a corrupt ledger must not kill a run
+            print(f"WARNING: ledger {ledger_path} not loaded: {e}")
+    # expected warmth for ETA priors: accel engines pay compile unless
+    # the size-plan records exist; the cpu interpreter never compiles
+    expected_warmth = "warm"
+    if args.engine in ("tpu", "tpu-spmd") and not (
+            args.compile_records and
+            os.path.exists(args.compile_records)):
+        expected_warmth = "cold"
+    budget_s = getattr(args, "budget_s", None)
+    budget_s = budget_s if budget_s and budget_s > 0 else None
+    est = progress.ledger_estimator(led, engine=args.engine,
+                                    scale_factor=run_scale_factor,
+                                    warmth=expected_warmth)
+    queue = progress.BudgetedQueue(list(query_dict), budget_s, est,
+                                   phase="power")
+    hb = progress.Heartbeat("power", total=len(query_dict),
+                            budget_s=budget_s)
+    executed: List[str] = []
+
     power_start = int(time.time())
     stream_span = obs.span(stream_name, cat="stream", collect=True,
                            engine=args.engine, n_queries=len(query_dict))
     stream_span.__enter__()
-    for query_name, q_content in query_dict.items():
+    while True:
+        query_name = queue.next(time.time() - total_start)
+        if query_name is None:
+            break
+        q_content = query_dict[query_name]
+        hb.beat(len(executed) + 1, query_name,
+                time.time() - total_start, eta_s=queue.projected_s())
         print(f"====== Run {query_name} ======")
         # abandoned-thread gate: give zombies a short grace window to
         # drain before sharing the device with the next query
@@ -387,7 +431,13 @@ def run_query_stream(args) -> None:
             else:
                 prefix = os.path.join(args.json_summary_folder, "")
             q_report.write_summary(query_name, prefix=prefix)
+        executed.append(query_name)
     stream_span.__exit__(None, None, None)
+    if queue.skipped:
+        print(f"WARNING: power run partial - {len(queue.skipped)} "
+              f"queries cut by the {budget_s:g}s budget; per-query "
+              f"partial_reason recorded in the metrics sidecar")
+        obs.inc("harness.budget.queries_skipped", len(queue.skipped))
     power_end = int(time.time())
     power_elapse = int((power_end - power_start) * 1000)
     total_elapse = int((time.time() - total_start) * 1000)
@@ -425,6 +475,36 @@ def run_query_stream(args) -> None:
         trace_dir = os.environ.get("NDSTPU_TRACE_DIR") or \
             (os.path.dirname(args.time_log) or ".")
         base = os.path.basename(args.time_log)
+        # sentinel verdicts are judged against the PRE-run ledger, then
+        # this run's measurements are appended so the next run has
+        # priors; failed queries never contribute baselines
+        sentinel_block = None
+        ledger_block = None
+        qsums = [q for q in obs.tracer().query_summaries()
+                 if q["query"] in set(executed)]
+        if led is not None and qsums:
+            try:
+                sentinel_block = sentinel.classify_run(
+                    qsums, led, engine=args.engine,
+                    scale_factor=run_scale_factor)
+                entries = [ledger_mod.make_entry(
+                    q["query"], q["wall_s"], q["compile_s"],
+                    q["execute_s"], engine=args.engine,
+                    scale_factor=run_scale_factor, seed=run_seed,
+                    source=os.path.basename(args.time_log))
+                    for q in qsums
+                    if not (q.get("attrs") or {}).get("error")]
+                led.append(entries)
+                ledger_block = {"path": led.path,
+                                "appended": len(entries)}
+                if sentinel_block["regressions"]:
+                    print(f"WARNING: sentinel flagged warm-path "
+                          f"regressions: "
+                          f"{sentinel_block['regressions']} "
+                          f"(scripts/regression_check.py exits "
+                          f"nonzero on these)")
+            except Exception as e:  # ledger must never fail the run
+                print(f"WARNING: ledger/sentinel update failed: {e}")
         try:
             paths = obs.export_run(trace_dir, base)
             sidecar = args.time_log + ".metrics.json"
@@ -435,6 +515,11 @@ def run_query_stream(args) -> None:
                     "stream": stream_name,
                     "power_elapse_ms": power_elapse,
                     "total_elapse_ms": total_elapse,
+                    "budget_s": budget_s,
+                    "partial": bool(queue.skipped),
+                    "partial_reasons": queue.skipped,
+                    "ledger": ledger_block,
+                    "sentinel": sentinel_block,
                 }), f, indent=2)
             print(f"====== Trace: {paths['jsonl']} | {paths['chrome']} "
                   f"| {sidecar} ======")
@@ -478,6 +563,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="path for persisted whole-query size-plan "
                         "records (skip per-query discovery on repeat "
                         "power runs; tpu engines only)")
+    p.add_argument("--budget_s", type=float,
+                   default=float(os.environ.get(
+                       "NDSTPU_PHASE_BUDGET_S", "0") or 0),
+                   help="phase deadline budget in seconds (0 = none; "
+                        "default from NDSTPU_PHASE_BUDGET_S). On "
+                        "projected overrun the run degrades "
+                        "explicitly: remaining queries reorder "
+                        "cheapest-first by ledger prior and cut "
+                        "queries get a per-query partial_reason in "
+                        "the metrics sidecar")
+    p.add_argument("--ledger",
+                   help="run-ledger JSONL path (default "
+                        "$NDSTPU_LEDGER or .bench_cache/ledger.jsonl; "
+                        "'none' disables). Serves ETA priors and "
+                        "regression-sentinel baselines; executed "
+                        "queries are appended after the run")
+    p.add_argument("--scale_factor", default="unknown",
+                   help="scale factor for ledger fingerprinting "
+                        "(the bench driver passes it)")
+    p.add_argument("--run_seed", default="unknown",
+                   help="stream rngseed for ledger fingerprinting "
+                        "(the bench driver passes the resolved seed)")
     p.add_argument("--floats", action="store_true",
                    help="double mode (no decimals)")
     return p

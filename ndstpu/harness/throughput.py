@@ -15,46 +15,149 @@ see ndstpu.harness.admission.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
 import tempfile
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ndstpu import obs
 
 
+def concurrency_timeline(records: List[dict]) -> dict:
+    """Overlap evidence from per-stream (start, end) intervals: max
+    concurrent streams (event sweep) + pairwise overlap seconds.  This
+    is the committed evidence the ``admission.py`` ``concurrent: N``
+    cap is judged against — with admission working, max_concurrent at
+    the *device* stays <= N while the wall-clock streams still overlap
+    (they queue at the gate, not in the driver)."""
+    points = []
+    for r in records:
+        points.append((r["start_epoch_s"], 1))
+        points.append((r["end_epoch_s"], -1))
+    points.sort()
+    cur = peak = 0
+    for _, d in points:
+        cur += d
+        peak = max(peak, cur)
+    pairwise: Dict[str, float] = {}
+    total_overlap = 0.0
+    for i, a in enumerate(records):
+        for b in records[i + 1:]:
+            ov = min(a["end_epoch_s"], b["end_epoch_s"]) - \
+                max(a["start_epoch_s"], b["start_epoch_s"])
+            ov = max(ov, 0.0)
+            # records arrive in completion order; key order-stably
+            key = "&".join(sorted((a["stream"], b["stream"])))
+            pairwise[key] = round(ov, 3)
+            total_overlap += ov
+    return {
+        "max_concurrent": peak,
+        "pairwise_overlap_s": pairwise,
+        "total_pairwise_overlap_s": round(total_overlap, 3),
+    }
+
+
 def run_throughput(stream_ids: List[str], cmd_template: List[str],
-                   concurrent: Optional[int] = None) -> int:
+                   concurrent: Optional[int] = None,
+                   budget_s: Optional[float] = None,
+                   overlap_report: Optional[str] = None) -> int:
     env = None
     lock_dir = None
+    child_env: Dict[str, str] = {}
     if concurrent is not None:
         lock_dir = tempfile.mkdtemp(prefix="ndstpu_adm")
-        env = dict(os.environ,
-                   NDSTPU_ADMISSION_SLOTS=str(concurrent),
-                   NDSTPU_ADMISSION_DIR=lock_dir)
+        child_env.update(NDSTPU_ADMISSION_SLOTS=str(concurrent),
+                         NDSTPU_ADMISSION_DIR=lock_dir)
+    if budget_s:
+        # each stream is a full power run on the same phase deadline;
+        # the power CLI picks this up and degrades explicitly
+        child_env["NDSTPU_PHASE_BUDGET_S"] = str(budget_s)
+    if child_env:
+        env = dict(os.environ, **child_env)
     try:
-        procs = []
+        t0 = time.time()
+        pending = {}
         starts = {}
         for sid in stream_ids:
             cmd = [arg.replace("{}", sid) for arg in cmd_template]
             print("launch:", " ".join(cmd))
             starts[sid] = time.time()
             obs.inc("harness.throughput.streams_launched")
-            procs.append((sid, subprocess.Popen(cmd, env=env)))
+            pending[sid] = subprocess.Popen(cmd, env=env)
         rc = 0
-        for sid, p in procs:
-            p.wait()
-            # stream lifetimes overlap, so a context-manager span cannot
-            # express them — record each with explicit timestamps (the
-            # per-query detail lives in each stream process's own trace)
-            obs.record(f"stream_{sid}", "stream", starts[sid],
-                       time.time() - starts[sid],
-                       returncode=p.returncode)
-            if p.returncode:
-                obs.inc("harness.throughput.streams_failed")
-            rc = rc or p.returncode
+        records: List[dict] = []
+        last_hb = time.time()
+        # poll instead of wait() so each stream's end timestamp is
+        # observed when it actually exits (sequential wait() would
+        # charge an early finisher the laggards' runtime and inflate
+        # the overlap evidence)
+        while pending:
+            for sid, p in list(pending.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del pending[sid]
+                end = time.time()
+                wall = end - starts[sid]
+                # stream lifetimes overlap, so a context-manager span
+                # cannot express them — record each with explicit
+                # timestamps (the per-query detail lives in each
+                # stream process's own trace)
+                obs.record(f"stream_{sid}", "stream", starts[sid],
+                           wall, returncode=code)
+                records.append({
+                    "stream": sid,
+                    "start_epoch_s": round(starts[sid], 3),
+                    "end_epoch_s": round(end, 3),
+                    "wall_s": round(wall, 3),
+                    "returncode": code,
+                })
+                done = len(records)
+                line = (f"[heartbeat] throughput stream {sid} done "
+                        f"{done}/{len(stream_ids)} wall={wall:.1f}s "
+                        f"elapsed={end - t0:.1f}s")
+                if budget_s:
+                    line += (f" budget={budget_s:g}s "
+                             f"remaining={budget_s - (end - t0):.1f}s")
+                print(line)
+                if code:
+                    obs.inc("harness.throughput.streams_failed")
+                rc = rc or code
+            if pending:
+                time.sleep(0.05)
+                if time.time() - last_hb >= 30.0:
+                    last_hb = time.time()
+                    el = last_hb - t0
+                    line = (f"[heartbeat] throughput "
+                            f"{len(records)}/{len(stream_ids)} streams "
+                            f"done elapsed={el:.1f}s")
+                    if budget_s:
+                        line += (f" budget={budget_s:g}s "
+                                 f"remaining={budget_s - el:.1f}s")
+                    print(line)
+        timeline = concurrency_timeline(records)
+        obs.set_gauge("harness.throughput.max_concurrent_streams",
+                      timeline["max_concurrent"])
+        if overlap_report:
+            doc = {
+                "format": "ndstpu-throughput-overlap-v1",
+                "admission_slots": concurrent,
+                "budget_s": budget_s,
+                "streams": sorted(records,
+                                  key=lambda r: r["start_epoch_s"]),
+                **timeline,
+            }
+            d = os.path.dirname(overlap_report)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(overlap_report, "w") as f:
+                json.dump(doc, f, indent=2)
+            print(f"====== Overlap evidence: {overlap_report} "
+                  f"(max_concurrent={timeline['max_concurrent']}, "
+                  f"admission_slots={concurrent}) ======")
         return rc
     finally:
         if lock_dir is not None:
@@ -63,36 +166,56 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
 
 
 def main(argv: List[str]) -> int:
-    # --concurrent belongs to the wrapper: parse it only from the part
-    # BEFORE the "--" separator so the wrapped command's flags are safe
+    # wrapper flags are parsed only from the part BEFORE the "--"
+    # separator so the wrapped command's own flags are safe
     sep = argv.index("--") if "--" in argv else None
     head = argv[:sep] if sep is not None else argv
-    concurrent = None
-    if "--concurrent" in head:
-        i = head.index("--concurrent")
+
+    def take(flag: str, cast, check=None):
+        if flag not in head:
+            return None, None
+        i = head.index(flag)
         if i + 1 >= len(head):
-            print("--concurrent requires a value", file=sys.stderr)
-            return 2
+            return None, f"{flag} requires a value"
         try:
-            concurrent = int(head[i + 1])
+            val = cast(head[i + 1])
         except ValueError:
-            print(f"--concurrent: not an integer: {head[i + 1]}",
-                  file=sys.stderr)
-            return 2
-        if concurrent < 1:
-            print("--concurrent must be >= 1", file=sys.stderr)
-            return 2
-        head = head[:i] + head[i + 2:]
+            return None, f"{flag}: bad value: {head[i + 1]}"
+        if check and not check(val):
+            return None, f"{flag}: out of range: {val}"
+        del head[i:i + 2]
+        return val, None
+
+    concurrent, err = take("--concurrent", int, lambda v: v >= 1)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    budget_s, err = take("--budget_s", float, lambda v: v > 0)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    overlap_report, err = take("--overlap_report", str)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    if budget_s is None and os.environ.get("NDSTPU_PHASE_BUDGET_S"):
+        try:
+            budget_s = float(os.environ["NDSTPU_PHASE_BUDGET_S"])
+        except ValueError:
+            pass
     if sep is not None:
         ids_arg, cmd = head, argv[sep + 1:]
     else:
         ids_arg, cmd = head[:1], head[1:]
     if not ids_arg or not cmd:
-        print("usage: throughput <id,id,...> [--concurrent N] -- "
+        print("usage: throughput <id,id,...> [--concurrent N] "
+              "[--budget_s S] [--overlap_report PATH] -- "
               "<command with {} placeholders>", file=sys.stderr)
         return 2
     stream_ids = [s for s in ids_arg[0].split(",") if s]
-    return run_throughput(stream_ids, cmd, concurrent)
+    return run_throughput(stream_ids, cmd, concurrent,
+                          budget_s=budget_s,
+                          overlap_report=overlap_report)
 
 
 if __name__ == "__main__":

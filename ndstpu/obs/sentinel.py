@@ -1,0 +1,174 @@
+"""Regression sentinel: classify every query of a run vs the ledger.
+
+After any power run, each measured query gets one verdict against its
+best-known-warm ledger prior (ndstpu/obs/ledger.py):
+
+* ``cold-compile`` — the tracer's compile/execute split says compile
+  work happened (discovery / jit build / first XLA compile).  A first
+  compile is **never** a regression, whatever the wall clock says;
+  the verdict carries the ``execute_s`` split as the warm-path proxy
+  so the run still contributes a baseline.
+* ``new`` — no warm baseline exists for this (engine, scale-factor)
+  scope; the run seeds one.
+* ``regressed`` / ``improved`` — warm wall beyond both the relative
+  tolerance and the absolute floor (both guards: a 0.1 s query
+  jittering to 0.14 s is noise, not a regression).
+* ``flat`` — within tolerance.
+* ``failed`` — the query errored; excluded from baselines.
+
+Only ``regressed`` verdicts are exit-code-worthy: the CLI wrapper
+(scripts/regression_check.py) exits nonzero on genuine warm-path
+regressions so CI and the bench driver both see them, and writes
+``REGRESSIONS.json`` + a markdown table for the artifact trail.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ndstpu.obs import ledger as ledger_mod
+
+REL_TOL = 0.25      # regressed/improved only beyond +-25% ...
+ABS_FLOOR_S = 0.25  # ... AND more than 0.25s absolute movement
+
+VERDICTS = ("improved", "flat", "regressed", "cold-compile", "new",
+            "failed")
+
+
+def classify_query(query: str, wall_s: float, compile_s: float,
+                   execute_s: float, baseline_warm_s: Optional[float],
+                   rel_tol: float = REL_TOL,
+                   abs_floor_s: float = ABS_FLOOR_S) -> dict:
+    """One verdict.  Cold-compile is decided FIRST, from the measured
+    compile/execute split, so a first compile can never be flagged as
+    a regression regardless of how slow the wall was."""
+    out = {
+        "query": query,
+        "wall_s": round(wall_s, 6),
+        "compile_s": round(compile_s, 6),
+        "execute_s": round(execute_s, 6),
+        "baseline_warm_s": None if baseline_warm_s is None
+        else round(baseline_warm_s, 6),
+    }
+    if ledger_mod.derive_warmth(wall_s, compile_s) == "cold":
+        out["verdict"] = "cold-compile"
+        out["reason"] = (
+            f"compile_s={compile_s:.3f}s of wall={wall_s:.3f}s is "
+            f"first-compile work, not a warm-path cost; warm proxy "
+            f"execute_s={execute_s:.3f}s"
+            + (f" vs baseline {baseline_warm_s:.3f}s"
+               if baseline_warm_s is not None else " (no baseline yet)"))
+        return out
+    if baseline_warm_s is None:
+        out["verdict"] = "new"
+        out["reason"] = "no warm baseline in ledger scope; seeding one"
+        return out
+    delta = wall_s - baseline_warm_s
+    out["delta_s"] = round(delta, 6)
+    out["ratio"] = round(wall_s / baseline_warm_s, 4) \
+        if baseline_warm_s > 0 else None
+    if delta > abs_floor_s and wall_s > baseline_warm_s * (1 + rel_tol):
+        out["verdict"] = "regressed"
+        out["reason"] = (f"warm wall {wall_s:.3f}s vs best-known-warm "
+                         f"{baseline_warm_s:.3f}s (+{delta:.3f}s, "
+                         f"x{out['ratio']})")
+    elif -delta > abs_floor_s and \
+            wall_s < baseline_warm_s * (1 - rel_tol):
+        out["verdict"] = "improved"
+        out["reason"] = (f"warm wall {wall_s:.3f}s vs best-known-warm "
+                         f"{baseline_warm_s:.3f}s ({delta:.3f}s, "
+                         f"x{out['ratio']})")
+    else:
+        out["verdict"] = "flat"
+        out["reason"] = (f"within tolerance of best-known-warm "
+                         f"{baseline_warm_s:.3f}s")
+    return out
+
+
+def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
+                 engine: Optional[str] = None, scale_factor=None,
+                 rel_tol: float = REL_TOL,
+                 abs_floor_s: float = ABS_FLOOR_S) -> dict:
+    """Classify a run's per-query summaries (the power sidecar /
+    ``query_summaries()`` shape: query, wall_s, compile_s, execute_s,
+    optional attrs.error).  Baselines are scoped strictly to
+    (engine, scale_factor) — cross-engine comparisons are meaningless."""
+    verdicts: List[dict] = []
+    for q in queries:
+        name = q["query"]
+        if (q.get("attrs") or {}).get("error"):
+            verdicts.append({
+                "query": name, "wall_s": round(q.get("wall_s", 0.0), 6),
+                "verdict": "failed",
+                "reason": f"query errored: {q['attrs']['error']}",
+            })
+            continue
+        base = led.best_warm(name, engine=engine,
+                             scale_factor=scale_factor)
+        verdicts.append(classify_query(
+            name, q.get("wall_s", 0.0), q.get("compile_s", 0.0),
+            q.get("execute_s", 0.0), base, rel_tol=rel_tol,
+            abs_floor_s=abs_floor_s))
+    counts: dict = {}
+    for v in verdicts:
+        counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    return {
+        "format": "ndstpu-regressions-v1",
+        "engine": engine,
+        "scale_factor": None if scale_factor is None
+        else str(scale_factor),
+        "rel_tol": rel_tol,
+        "abs_floor_s": abs_floor_s,
+        "counts": counts,
+        "regressions": [v["query"] for v in verdicts
+                        if v["verdict"] == "regressed"],
+        "verdicts": verdicts,
+    }
+
+
+def markdown_table(result: dict) -> str:
+    """REGRESSIONS.md body: one row per query, regressions first."""
+    order = {"regressed": 0, "improved": 1, "new": 2, "flat": 3,
+             "cold-compile": 4, "failed": 5}
+    rows = sorted(result["verdicts"],
+                  key=lambda v: (order.get(v["verdict"], 9), v["query"]))
+    lines = [
+        "# Regression sentinel",
+        "",
+        f"engine={result.get('engine')} "
+        f"sf={result.get('scale_factor')} "
+        f"counts={result.get('counts')}",
+        "",
+        "| query | wall_s | baseline_warm_s | delta_s | ratio | "
+        "verdict |",
+        "|---|---|---|---|---|---|",
+    ]
+    for v in rows:
+        lines.append(
+            "| {q} | {w} | {b} | {d} | {r} | {v} |".format(
+                q=v["query"], w=v.get("wall_s", ""),
+                b=v.get("baseline_warm_s", ""),
+                d=v.get("delta_s", ""), r=v.get("ratio", ""),
+                v=v["verdict"]))
+    return "\n".join(lines) + "\n"
+
+
+def write_reports(result: dict, json_path: Optional[str] = None,
+                  md_path: Optional[str] = None) -> dict:
+    import json as _json
+    import os as _os
+    paths = {}
+    for p in (json_path, md_path):
+        if p:
+            d = _os.path.dirname(p)
+            if d:
+                _os.makedirs(d, exist_ok=True)
+    if json_path:
+        with open(json_path, "w") as f:
+            _json.dump(result, f, indent=2)
+        paths["json"] = json_path
+    if md_path:
+        with open(md_path, "w") as f:
+            f.write(markdown_table(result))
+        paths["md"] = md_path
+    return paths

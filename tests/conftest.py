@@ -12,6 +12,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # replay warm-up (compile at discovery) would add minutes of XLA:CPU
 # compiles across the suite; tests that exercise it opt in explicitly
 os.environ.setdefault("NDSTPU_WARM_REPLAY", "0")
+# keep test power runs (and their subprocesses, which inherit env) out
+# of the developer's real .bench_cache/ledger.jsonl — tests that need a
+# ledger pass --ledger explicitly, which wins over this default
+os.environ.setdefault("NDSTPU_LEDGER", "none")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

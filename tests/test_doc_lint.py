@@ -1,0 +1,79 @@
+"""Doc/artifact citation lint tests (ndstpu/obs/artifact_lint.py,
+scripts/doc_lint.py) — the committed tree must never cite a ghost
+artifact, and stale perf artifacts must say so."""
+
+import json
+import os
+import subprocess
+import sys
+
+from ndstpu.obs import artifact_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_missing_citation_fails(tmp_path):
+    (tmp_path / "docs").mkdir()
+    text = "See `docs/GHOST_BENCH.json` for the numbers.\n"
+    findings = artifact_lint.lint_text(text, str(tmp_path), doc="d.md")
+    assert len(findings) == 1
+    assert "docs/GHOST_BENCH.json" in findings[0]
+
+
+def test_present_artifact_and_pending_marker_pass(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "REAL.json").write_text("{}")
+    text = ("cites `docs/REAL.json` (committed)\n"
+            "and `docs/FUTURE.json` is pending a hardware run\n"
+            "plus an uncommitted `BENCH_r99.json` snapshot\n")
+    assert artifact_lint.lint_text(text, str(tmp_path)) == []
+
+
+def test_bench_root_citations_checked(tmp_path):
+    text = "headline in `BENCH_r42.json`\n"
+    assert artifact_lint.lint_text(text, str(tmp_path)) != []
+    (tmp_path / "BENCH_r42.json").write_text("{}")
+    assert artifact_lint.lint_text(text, str(tmp_path)) == []
+
+
+def test_config_mismatch_flagged_unless_stale(tmp_path):
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    current = {"NDSTPU_GROUPBY": "pallas"}
+    art = {"engine_defaults": {"NDSTPU_GROUPBY": "auto"}, "data": {}}
+    (docs / "A.json").write_text(json.dumps(art))
+    findings = artifact_lint.artifact_config_mismatches(
+        str(tmp_path), current=current)
+    assert len(findings) == 1 and "NDSTPU_GROUPBY" in findings[0]
+    # the stale stamp is the escape hatch: artifact admits its age
+    art["stale"] = True
+    (docs / "A.json").write_text(json.dumps(art))
+    assert artifact_lint.artifact_config_mismatches(
+        str(tmp_path), current=current) == []
+
+
+def test_current_defaults_parsed_from_source():
+    cur = artifact_lint.current_engine_defaults(REPO)
+    assert cur.get("NDSTPU_GROUPBY") in ("pallas", "auto", "sort")
+
+
+def test_committed_tree_is_clean():
+    assert artifact_lint.lint_repo(REPO) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "doc_lint.py")],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a tree citing a ghost artifact fails
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "bad.md").write_text(
+        "numbers in `docs/NOT_THERE.json`\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "doc_lint.py"),
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    assert "NOT_THERE" in r.stdout

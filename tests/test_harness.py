@@ -123,8 +123,10 @@ def test_validate_pass_and_fail(dataset, env, tmp_path):
 
 
 def test_throughput_concurrent_streams(dataset, env, tmp_path):
+    overlap = tmp_path / "overlap.json"
     r = subprocess.run(
-        ["python", "-m", "ndstpu.harness.throughput", "1,2", "--",
+        ["python", "-m", "ndstpu.harness.throughput", "1,2",
+         "--overlap_report", str(overlap), "--",
          "python", "-m", "ndstpu.harness.power",
          str(dataset / "streams") + "/query_{}.sql",
          str(dataset / "wh"),
@@ -137,6 +139,115 @@ def test_throughput_concurrent_streams(dataset, env, tmp_path):
     # throughput elapsed derivable from the stream logs
     tt = bench_mod.get_throughput_time(str(tmp_path / "time"), 5, 1)
     assert tt >= 0  # 1s timestamp resolution: tiny runs can be 0
+    # overlap evidence artifact: both streams recorded with true
+    # start/end epochs; two unbounded streams on one host overlap
+    ov = json.loads(overlap.read_text())
+    assert ov["format"] == "ndstpu-throughput-overlap-v1"
+    assert {s["stream"] for s in ov["streams"]} == {"1", "2"}
+    assert ov["max_concurrent"] == 2
+    assert ov["pairwise_overlap_s"]["1&2"] > 0
+    for s in ov["streams"]:
+        assert s["end_epoch_s"] >= s["start_epoch_s"]
+        assert s["returncode"] == 0
+
+
+def test_concurrency_timeline():
+    from ndstpu.harness.throughput import concurrency_timeline
+    recs = [
+        {"stream": "1", "start_epoch_s": 0.0, "end_epoch_s": 10.0},
+        {"stream": "2", "start_epoch_s": 5.0, "end_epoch_s": 15.0},
+        {"stream": "3", "start_epoch_s": 14.0, "end_epoch_s": 20.0},
+    ]
+    tl = concurrency_timeline(recs)
+    assert tl["max_concurrent"] == 2
+    assert tl["pairwise_overlap_s"] == {"1&2": 5.0, "2&3": 1.0,
+                                        "1&3": 0.0}
+    assert tl["total_pairwise_overlap_s"] == 6.0
+
+
+def test_power_budget_degradation(dataset, env, tmp_path):
+    """A power run whose ledger priors project past the budget must
+    degrade explicitly: cheapest-first reorder, per-query
+    partial_reason in the sidecar (never a bare partial flag), and
+    greppable heartbeat/budget lines (docs/OBSERVABILITY.md)."""
+    from ndstpu.obs import ledger as ledger_mod
+
+    ledger_path = tmp_path / "ledger.jsonl"
+    led = ledger_mod.Ledger(str(ledger_path))
+    # priors: two sub-second queries, two that can never fit a 30s
+    # budget -> deterministic reorder + cut whatever the host speed
+    for q, wall in (("query42", 0.02), ("query3", 0.05),
+                    ("query96", 500.0), ("query55", 600.0)):
+        led.append(ledger_mod.make_entry(
+            q, wall, execute_s=wall, engine="cpu",
+            scale_factor="unknown", seed="unknown", warmth="warm",
+            source="seed"))
+    time_log = tmp_path / "time.csv"
+    r = subprocess.run(
+        ["python", "-m", "ndstpu.harness.power",
+         str(dataset / "streams" / "query_0.sql"),
+         str(dataset / "wh"), str(time_log),
+         "--sub_queries", "query96,query3,query55,query42",
+         "--budget_s", "30", "--ledger", str(ledger_path)],
+        check=True, env=env, capture_output=True, text=True)
+    assert "[heartbeat] power" in r.stdout
+    assert "cheapest-first" in r.stdout
+    csv_queries = [line.split(",")[1]
+                   for line in time_log.read_text().splitlines()[1:]
+                   if line.split(",")[1:2] and
+                   line.split(",")[1].startswith("query")]
+    # cheapest-first: query42 (0.02s prior) ran before query3 (0.05s);
+    # the 500/600s-prior queries were cut and wrote NO time-log row
+    assert csv_queries == ["query42", "query3"]
+    sidecar = json.loads(
+        (tmp_path / "time.csv.metrics.json").read_text())
+    assert sidecar["partial"] is True
+    assert set(sidecar["partial_reasons"]) == {"query96", "query55"}
+    for q, reason in sidecar["partial_reasons"].items():
+        assert "budget" in reason and "30" in reason, (q, reason)
+    # the executed queries were appended to the ledger
+    led2 = ledger_mod.Ledger(str(ledger_path))
+    appended = [e for e in led2.entries if e["source"] == "time.csv"]
+    assert {e["query"] for e in appended} == {"query42", "query3"}
+
+
+def test_power_ledger_sentinel_two_runs(dataset, env, tmp_path):
+    """Acceptance loop: run the same stream twice against a fresh
+    ledger.  Run 1 seeds baselines (verdict `new`); run 2 is judged
+    against them with no cold-compile false positives, and every
+    executed query has a ledger entry + sentinel verdict."""
+    from ndstpu.obs import ledger as ledger_mod
+
+    ledger_path = tmp_path / "ledger.jsonl"
+    sub = "query3,query42,query55,query96,query52"
+    sidecars = []
+    for tag in ("r1", "r2"):
+        time_log = tmp_path / f"{tag}.csv"
+        subprocess.run(
+            ["python", "-m", "ndstpu.harness.power",
+             str(dataset / "streams" / "query_0.sql"),
+             str(dataset / "wh"), str(time_log),
+             "--sub_queries", sub, "--ledger", str(ledger_path)],
+            check=True, env=env)
+        sidecars.append(json.loads(
+            (tmp_path / f"{tag}.csv.metrics.json").read_text()))
+    names = set(sub.split(","))
+    led = ledger_mod.Ledger(str(ledger_path))
+    for tag, sc in zip(("r1", "r2"), sidecars):
+        verdicts = {v["query"]: v for v in sc["sentinel"]["verdicts"]}
+        assert set(verdicts) == names, tag
+        entries = {e["query"] for e in led.entries
+                   if e["source"] == f"{tag}.csv"}
+        assert entries == names, tag
+    # run 1 had no baselines; the cpu interpreter never compiles, so
+    # every verdict is `new`, and run 2 must be judged against run 1's
+    # entries (baseline present, never cold-compile)
+    assert sidecars[0]["sentinel"]["counts"] == {"new": len(names)}
+    for v in sidecars[1]["sentinel"]["verdicts"]:
+        assert v["verdict"] != "cold-compile"
+        assert v["verdict"] != "new"
+        assert v["baseline_warm_s"] is not None
+    assert sidecars[1]["ledger"]["appended"] == len(names)
 
 
 def test_maintenance_insert_delete_and_rollback(dataset, env, tmp_path):
