@@ -1,0 +1,110 @@
+"""Static plan analyzer: typecheck + lowering audit, no data, no jax.
+
+The analyzer runs on plans alone.  :func:`schema_catalog` builds a
+zero-row engine catalog straight from ``ndstpu.schema`` so the planner
+and optimizer can produce exactly the plans the runtime would see —
+``Session.plan()`` is jax-free by construction — while nothing is ever
+loaded or executed.
+
+Typical use (scripts/plan_lint.py, harness/power.py --static_check)::
+
+    from ndstpu import analysis
+    res = analysis.analyze_sql(sess, name, sql, scale_factor=1.0)
+    res.verdict            # "device" | "fallback"
+    res.diagnostics        # typing + lowering + SPMD findings
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ndstpu import schema as nds_schema
+from ndstpu.engine import columnar
+from ndstpu.analysis import diagnostics, lowering, typecheck
+from ndstpu.analysis.diagnostics import Diagnostic
+from ndstpu.analysis.lowering import audit_plan
+from ndstpu.analysis.typecheck import infer_plan
+
+__all__ = [
+    "AnalysisResult", "Diagnostic", "analyze_plan", "analyze_sql",
+    "audit_plan", "diagnostics", "infer_plan", "lowering",
+    "schema_catalog", "schema_tables", "typecheck",
+]
+
+
+def schema_tables(use_decimal: bool = True) -> Dict[str, object]:
+    """All table schemas (source + maintenance views' bases) by name."""
+    tables = dict(nds_schema.get_schemas(use_decimal=use_decimal))
+    tables.update(nds_schema.get_maintenance_schemas(
+        use_decimal=use_decimal))
+    return tables
+
+
+def schema_catalog(use_decimal: bool = True):
+    """Zero-row engine catalog over the full TPC-DS schema — enough for
+    ``Session.plan()`` (parse → plan → optimize) without any warehouse."""
+    from ndstpu.io import loader
+
+    cat = loader.Catalog()
+    for name, ts in schema_tables(use_decimal=use_decimal).items():
+        cols = {}
+        for spec in ts.columns:
+            dt = columnar.numpy_dtype(spec.dtype)
+            cols[spec.name] = columnar.Column(
+                np.empty(0, dtype=dt), spec.dtype,
+                valid=None,
+                dictionary=(np.empty(0, dtype=object)
+                            if spec.dtype.kind == "string" else None))
+        cat.register(name, columnar.Table(cols))
+    return cat
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    """Combined static analysis of one query part."""
+
+    query: str
+    verdict: str                      # "device" | "fallback"
+    diagnostics: List[Diagnostic]     # NDS1xx + NDS2xx + NDS3xx, sorted
+    schema: typecheck.Schema
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def fallback_codes(self) -> List[str]:
+        return sorted({d.code for d in self.diagnostics
+                       if d.severity == "error" and
+                       "/subquery[" not in d.path})
+
+
+def analyze_plan(plan, tables: Optional[Dict[str, object]] = None,
+                 query: str = "",
+                 scale_factor: Optional[float] = None,
+                 spmd: bool = True) -> AnalysisResult:
+    """Run schema inference (NDS1xx) + lowerability audit (NDS2xx/3xx)
+    over an optimized logical plan."""
+    tables = tables if tables is not None else schema_tables()
+    out_schema, type_diags = infer_plan(plan, tables, query=query,
+                                        scale_factor=scale_factor)
+    audit = audit_plan(plan, tables, query=query,
+                       scale_factor=scale_factor, spmd=spmd)
+    diags = diagnostics.sort_diagnostics(type_diags + audit.diagnostics)
+    return AnalysisResult(query=query, verdict=audit.verdict,
+                          diagnostics=diags, schema=out_schema)
+
+
+def analyze_sql(session, query: str, sql: str,
+                tables: Optional[Dict[str, object]] = None,
+                scale_factor: Optional[float] = None,
+                spmd: bool = True) -> AnalysisResult:
+    """Plan one SQL statement through ``session`` (jax-free path) and
+    analyze it.  ``session`` is an ``engine.session.Session`` — usually
+    over :func:`schema_catalog` so no data is touched."""
+    plan, _cols = session.plan(sql)
+    return analyze_plan(plan, tables=tables, query=query,
+                        scale_factor=scale_factor, spmd=spmd)

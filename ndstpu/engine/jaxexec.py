@@ -56,6 +56,10 @@ jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp  # noqa: E402
 
 from ndstpu import obs  # noqa: E402
+# the declarative supported-op registry is the single source of truth
+# shared with the static analyzer and scripts/spmd_coverage.py — keep
+# capability checks here pointing at it so the two can't drift
+from ndstpu.analysis import lowering as lowreg  # noqa: E402
 from ndstpu.engine import columnar, expr as ex, physical, plan as lp  # noqa: E402
 from ndstpu.engine.columnar import (  # noqa: E402
     BOOL,
@@ -471,7 +475,17 @@ def _plan_fp(o, out: Optional[list] = None) -> Optional[str]:
 
 
 class Unsupported(Exception):
-    """Raised at build time when an expr/plan has no device lowering."""
+    """Raised at build time when an expr/plan has no device lowering.
+
+    ``code`` is the static-analyzer diagnostic (NDS2xx, see
+    ndstpu/analysis/diagnostics.py) that predicts this raise site, so a
+    runtime fallback can say WHY in the tracer sidecar and run ledger.
+    Data-dependent guards the analyzer cannot see statically (rank
+    pairing capacity, distinct column type) stay uncoded."""
+
+    def __init__(self, msg: str, code: Optional[str] = None):
+        super().__init__(msg)
+        self.code = code
 
 
 def _civil_from_days(days: jnp.ndarray):
@@ -586,7 +600,7 @@ class JEval:
         if isinstance(value, str):
             d = np.array([value], dtype=object)
             return DCol(jnp.zeros(cap, jnp.int32), valid, STRING, d)
-        raise Unsupported(f"literal {value!r}")
+        raise Unsupported(f"literal {value!r}", code="NDS201")
 
     def cast(self, c: DCol, target: DType) -> DCol:
         k, tk = c.ctype.kind, target.kind
@@ -690,7 +704,7 @@ class JEval:
             return DCol(c.data.astype(jnp.int32), c.valid, DATE)
         if tk == "bool":
             return DCol(c.data.astype(jnp.bool_), c.valid, BOOL)
-        raise Unsupported(f"cast {c.ctype} -> {target}")
+        raise Unsupported(f"cast {c.ctype} -> {target}", code="NDS204")
 
     def _string_parse_float(self, c: DCol):
         vals = np.zeros(len(c.dictionary) + 1, dtype=np.float64)
@@ -738,7 +752,7 @@ class JEval:
             return self._func(e)
         if isinstance(e, ex.InList):
             return self._in_list(e)
-        raise Unsupported(f"expr {type(e).__name__}")
+        raise Unsupported(f"expr {type(e).__name__}", code="NDS201")
 
     # -- operators -----------------------------------------------------------
 
@@ -764,7 +778,7 @@ class JEval:
             return self._arith(op, lc, rc)
         if op == "||":
             return self._concat_pair(lc, rc)
-        raise Unsupported(f"binop {op}")
+        raise Unsupported(f"binop {op}", code="NDS202")
 
     def _align_compare(self, lc: DCol, rc: DCol):
         lk, rk = lc.ctype.kind, rc.ctype.kind
@@ -847,7 +861,7 @@ class JEval:
             return DCol(~c.valid, jnp.ones(self.cap, bool), BOOL)
         if e.op == "isnotnull":
             return DCol(c.valid, jnp.ones(self.cap, bool), BOOL)
-        raise Unsupported(f"unary {e.op}")
+        raise Unsupported(f"unary {e.op}", code="NDS203")
 
     def _case(self, e: ex.Case) -> DCol:
         conds, vals = [], []
@@ -931,7 +945,8 @@ class JEval:
                 arr = np.asarray(vals)
                 if arr.dtype == object or arr.dtype.kind in "US":
                     raise Unsupported(f"IN-list literals {arr.dtype} for "
-                                      f"{c.ctype.kind} column")
+                                      f"{c.ctype.kind} column",
+                                      code="NDS212")
                 data = jnp.isin(c.data, jnp.asarray(arr))
         if e.negated:
             # x NOT IN (..., NULL) is never TRUE (NULL semantics)
@@ -944,7 +959,7 @@ class JEval:
         cross-product dictionary (guarded against blowup) + device pair
         codes.  NULL || x is NULL (SQL semantics)."""
         if a.ctype.kind != "string" or b.ctype.kind != "string":
-            raise Unsupported("|| on non-string operands")
+            raise Unsupported("|| on non-string operands", code="NDS206")
         da = a.dictionary if a.dictionary is not None else np.empty(0, object)
         db = b.dictionary if b.dictionary is not None else np.empty(0, object)
         na, nb = len(da), len(db)
@@ -970,7 +985,8 @@ class JEval:
             data = jnp.where(valid, table[base.data], -1)
             return DCol(data, valid, STRING, uniq)
         if na * nb > (1 << 20):
-            raise Unsupported("|| dictionary cross-product too large")
+            raise Unsupported("|| dictionary cross-product too large",
+                              code="NDS213")
         uniq, table = encode(np.char.add(np.repeat(da.astype(str), nb),
                                          np.tile(db.astype(str), na)))
         pair = jnp.where(valid, a.data * nb + b.data, na * nb)
@@ -1076,12 +1092,12 @@ class JEval:
             eqc = self._compare("=", a, b)
             eq = eqc.data & eqc.valid
             return DCol(a.data, a.valid & ~eq, a.ctype, a.dictionary)
-        raise Unsupported(f"function {name}")
+        raise Unsupported(f"function {name}", code="NDS205")
 
     def _as_string(self, arg: ex.Expr) -> DCol:
         c = self.eval(arg)
         if c.ctype.kind != "string":
-            raise Unsupported("cast-to-string on device")
+            raise Unsupported("cast-to-string on device", code="NDS206")
         return c
 
     def predicate(self, e: ex.Expr) -> jnp.ndarray:
@@ -1267,6 +1283,7 @@ class JaxExecutor:
         self._oks: Optional[list] = None   # traced guard bools (replay)
         self._trace_tables: Optional[Dict[str, DTable]] = None
         self._used_fallback = False
+        self._fallback_codes: List[str] = []
         # compiled-query cache: plan identity -> _CompiledPlan
         self._compiled: Dict[int, "_CompiledPlan"] = {}
         # segmented compilation: fingerprint -> segment _CompiledPlan,
@@ -1401,19 +1418,27 @@ class JaxExecutor:
             return self._fallback(p)
         try:
             return m(p)
-        except Unsupported:
-            return self._fallback(p)
+        except Unsupported as u:
+            return self._fallback(p, code=u.code)
 
     # -- fallback ------------------------------------------------------------
 
-    def _fallback(self, p: lp.Plan) -> DTable:
+    def _fallback(self, p: lp.Plan, code: Optional[str] = None) -> DTable:
         """Run this node on the numpy interpreter; children still execute on
-        the device path and are pulled to host once."""
+        the device path and are pulled to host once.  ``code`` is the
+        NDS2xx diagnostic of the Unsupported that sent us here; it is
+        counted and annotated onto the enclosing query span so sidecar
+        and ledger rows record why the query fell back."""
         if self.mode == "replay":
             raise RuntimeError(
                 f"fallback for {type(p).__name__} during replay — "
                 "discovery should have marked this plan non-compilable")
         self._used_fallback = True
+        tag = f"{code or 'uncoded'}:{type(p).__name__}"
+        if tag not in self._fallback_codes:
+            self._fallback_codes.append(tag)
+        obs.inc(f"engine.fallback.{code or 'uncoded'}")
+        obs.annotate(fallback_codes=",".join(sorted(self._fallback_codes)))
         repl = self._replace_children_with_host(p)
         host = self.np_exec.execute(repl)
         return to_device(host)
@@ -1491,7 +1516,7 @@ class JaxExecutor:
                             self._resolve_subqueries(e.operand), vals,
                             e.negated)
                 else:
-                    raise Unsupported(f"subquery kind {e.kind}")
+                    raise Unsupported(f"subquery kind {e.kind}", code="NDS211")
             finally:
                 self.mode = outer
                 self._used_fallback = outer_fallback
@@ -1672,7 +1697,7 @@ class JaxExecutor:
                            cs[0].ctype, cs[0].dictionary, bounds)
         return DTable(cols, jnp.concatenate([t.alive for t in parts]))
 
-    _GS_COMBINABLE = ("count", "sum", "avg", "min", "max")
+    _GS_COMBINABLE = lowreg.GS_COMBINABLE_AGGS
 
     def _grouping_sets_partials(self, dt: DTable,
                                 p: lp.Aggregate) -> Optional[list]:
@@ -1943,14 +1968,14 @@ class JaxExecutor:
     def _check_agg_supported(self, e: ex.Expr):
         for node in e.walk():
             if isinstance(node, ex.AggExpr):
-                if node.distinct and node.func not in (
-                        "sum", "count", "avg", "min", "max"):
+                if node.distinct and \
+                        node.func not in lowreg.DISTINCT_AGG_FUNCS:
                     raise Unsupported(
-                        f"distinct aggregate {node.func} on device")
-                if node.func not in ("sum", "count", "avg", "min", "max",
-                                     "stddev_samp", "var_samp", "stddev",
-                                     "variance"):
-                    raise Unsupported(f"aggregate {node.func}")
+                        f"distinct aggregate {node.func} on device",
+                        code="NDS207")
+                if node.func not in lowreg.SUPPORTED_AGG_FUNCS:
+                    raise Unsupported(f"aggregate {node.func}",
+                                      code="NDS207")
 
     def _eval_agg(self, dt: DTable, evl: JEval, e: ex.Expr, gid, ngseg,
                   out_alive, order, use_pallas: bool = False) -> DCol:
@@ -2008,7 +2033,8 @@ class JaxExecutor:
                 {"__x": DCol(jnp.zeros(ngseg, jnp.int32),
                              jnp.ones(ngseg, bool), INT32)}, out_alive)
             return JEval(gtable).eval(lowered)
-        raise Unsupported(f"aggregate output {type(e).__name__}")
+        raise Unsupported(f"aggregate output {type(e).__name__}",
+                          code="NDS208")
 
     def _scan_levels(self, gid, order) -> int:
         """Recorded bound on the compensated scan's doubling steps: the
@@ -2181,7 +2207,7 @@ class JaxExecutor:
                 0.0) / denom
             data = var if func in ("var_samp", "variance") else jnp.sqrt(var)
             return DCol(data, ok, FLOAT64)
-        raise Unsupported(f"aggregate {func}")
+        raise Unsupported(f"aggregate {func}", code="NDS207")
 
     # presence-bitmap distinct: ngseg x domain slots; 1<<22 int32 slots
     # = 16 MB peak, freed per aggregate
@@ -2280,7 +2306,8 @@ class JaxExecutor:
         out = dict(dt.columns)
         for name, e in p.exprs:
             if not isinstance(e, ex.WindowExpr):
-                raise Unsupported("non-window expr in Window node")
+                raise Unsupported("non-window expr in Window node",
+                                  code="NDS209")
             out[name] = self._window_column(dt, e)
         return DTable(out, dt.alive)
 
@@ -2380,7 +2407,7 @@ class JaxExecutor:
             out = seg(vals, gid, num_segments=cap)[gid]
             return DCol(out.astype(arg.data.dtype), got, arg.ctype,
                         arg.dictionary)
-        raise Unsupported(f"window {w.func}")
+        raise Unsupported(f"window {w.func}", code="NDS209")
 
     def _running_window(self, dt: DTable, evl: JEval, w: ex.WindowExpr,
                         pid, okeys: List[jnp.ndarray]) -> DCol:
@@ -2463,7 +2490,7 @@ class JaxExecutor:
             if arg.ctype.kind != "float64":
                 out = out.astype(arg.data.dtype)
             return DCol(out, got, arg.ctype, arg.dictionary)
-        raise Unsupported(f"running window {w.func}")
+        raise Unsupported(f"running window {w.func}", code="NDS209")
 
     # -- distinct ------------------------------------------------------------
 
@@ -2797,7 +2824,7 @@ class JaxExecutor:
             if p.extra is not None else None
         if kind == "cross" or not p.keys:
             if kind not in ("cross", "inner"):
-                raise Unsupported(f"non-equi {kind} join")
+                raise Unsupported(f"non-equi {kind} join", code="NDS210")
             return self._cross_join(lt, rt, extra)
         if kind == "right":
             out = self._equi_join(rt, lt,
@@ -2922,7 +2949,7 @@ class JaxExecutor:
             return out
         if kind == "left":
             return self._left_join(lt, rt, order, lo, counts, extra)
-        raise Unsupported(f"join kind {kind}")
+        raise Unsupported(f"join kind {kind}", code="NDS210")
 
     def _expand(self, lt: DTable, rt: DTable, order, lo, counts,
                 total, out_cap: int) -> DTable:
@@ -2997,6 +3024,9 @@ class _CompiledPlan:
     # output capacity after the final compact (segment replays feed the
     # parent at exactly this padded size)
     out_capacity: int = 0
+    # "NDSxxx:NodeName" tags for every fallback hit during discovery
+    # (empty when compilable) — the static analyzer's prediction target
+    fallback_codes: tuple = ()
 
 
 def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
@@ -3443,6 +3473,7 @@ class CompilingExecutor(JaxExecutor):
         self._in_discovery = True
         self._rec = []
         self._used_fallback = False
+        self._fallback_codes = []
         try:
             with host_compute():
                 dt = self.execute(p)
@@ -3457,6 +3488,7 @@ class CompilingExecutor(JaxExecutor):
             self.mode = "eager"
             self._in_discovery = False
         cp = _CompiledPlan(p, not self._used_fallback, self._rec, versions)
+        cp.fallback_codes = tuple(sorted(self._fallback_codes))
         cp.table_cols = _scan_columns(p)
         cp.out_capacity = dt.capacity
         cp.out_meta = [(name, c.ctype, c.dictionary, c.bounds)

@@ -18,6 +18,7 @@ Decorrelation rewrites (the reference corpus' patterns):
 from __future__ import annotations
 
 import dataclasses
+import difflib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ndstpu.engine import columnar, expr as ex, plan as lp
@@ -30,6 +31,13 @@ import numpy as np
 
 class PlanError(Exception):
     pass
+
+
+def _suggest(col: str, candidates: List[str]) -> str:
+    """Near-miss suffix for unresolved-column errors — a typo'd
+    reference names its likely targets instead of a bare failure."""
+    close = difflib.get_close_matches(col, candidates, n=3, cutoff=0.6)
+    return f" (did you mean: {', '.join(close)}?)" if close else ""
 
 
 def _parse_type(name: str) -> DType:
@@ -89,7 +97,8 @@ class Scope:
             for s in self.sources:
                 if s.alias == table:
                     if col not in s.columns:
-                        raise PlanError(f"no column {col} in {table}")
+                        raise PlanError(f"no column {col} in {table}"
+                                        + _suggest(col, s.columns))
                     return s.internal(col), False
         else:
             hits = [s for s in self.sources if col in s.columns]
@@ -98,11 +107,38 @@ class Scope:
             if hits:
                 return hits[0].internal(col), False
         if self.parent is not None:
-            name, _ = self.parent.resolve(table, col)
+            try:
+                name, _ = self.parent.resolve(table, col)
+            except PlanError as e:
+                if getattr(e, "unresolved", False):
+                    # re-raise with THIS scope's (wider) candidate set:
+                    # the innermost frame unwinds last, so the surfaced
+                    # message suggests over everything the reference
+                    # could actually see
+                    raise self._unresolved(table, col) from None
+                raise
             self.outer_refs.append(name)
             return name, True
+        raise self._unresolved(table, col)
+
+    def _unresolved(self, table: Optional[str], col: str) -> PlanError:
         where = f"{table}.{col}" if table else col
-        raise PlanError(f"cannot resolve column {where}")
+        e = PlanError(f"cannot resolve column {where}"
+                      + _suggest(col, self._candidates()))
+        e.unresolved = True
+        return e
+
+    def _candidates(self) -> List[str]:
+        """Every column name visible from this scope (chain upward)."""
+        out: List[str] = []
+        sc: Optional["Scope"] = self
+        while sc is not None:
+            for s in sc.sources:
+                for c in s.columns:
+                    if c not in out:
+                        out.append(c)
+            sc = sc.parent
+        return out
 
 
 class Planner:

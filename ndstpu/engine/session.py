@@ -196,10 +196,14 @@ class Session:
                     cache[ck] = (versions, exe)
                 self._spmd_used = True
                 return out
-            except (dplan.DistUnsupported, jaxexec.Unsupported):
+            except (dplan.DistUnsupported, jaxexec.Unsupported) as u:
                 # plan shape or an expression outside the distributed
                 # subset: the single-chip path below has per-plan fallback
                 obs.inc("engine.spmd.unsupported_fallbacks")
+                code = getattr(u, "code", None)
+                obs.annotate(spmd_fallback=f"{code or 'uncoded'}: {u}")
+                if code:
+                    obs.inc(f"engine.spmd.fallback.{code}")
             except Exception as e:  # noqa: BLE001
                 # a distributed-executor defect must degrade to the
                 # single-chip path, not fail the query; strict mode

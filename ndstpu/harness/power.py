@@ -118,6 +118,51 @@ def get_query_subset(query_dict, subset: List[str]):
     return OrderedDict((q, query_dict[q]) for q in subset)
 
 
+def static_check(sess: Session, query_dict, engine: str,
+                 scale_factor=None) -> List[str]:
+    """``--static_check`` gate: run the static analyzer over every queued
+    query (plan-only — no data, no XLA compile) and return the queries
+    with error-severity lowering diagnostics, printing each diagnostic's
+    code and plan location.  Error-severity NDS2xx means jaxexec WILL
+    fall back mid-run after paying the compile, so accel engines reject
+    the stream up front; the cpu interpreter executes everything, so
+    nothing gates there."""
+    from ndstpu import analysis
+
+    if engine not in ("tpu", "tpu-spmd"):
+        print("static check: cpu engine lowers everything; skipping")
+        return []
+    try:
+        sf = float(scale_factor)
+    except (TypeError, ValueError):
+        sf = None
+    tables = analysis.schema_tables()
+    offenders: List[str] = []
+    for name, sql in query_dict.items():
+        try:
+            plan, _cols = sess.plan(sql)
+        except Exception as e:
+            # parse/plan/optimize rejection: the run would die on this
+            # statement anyway, so it gates
+            offenders.append(name)
+            print(f"STATIC CHECK {name}: NDS000 at plan: {e}")
+            continue
+        try:
+            res = analysis.analyze_plan(plan, tables=tables, query=name,
+                                        scale_factor=sf)
+        except Exception as e:  # analyzer gaps must not block a run
+            print(f"WARNING: static check could not analyze {name}: {e}")
+            continue
+        gating = [d for d in res.diagnostics if d.severity == "error"
+                  and "/subquery[" not in d.path]
+        if gating:
+            offenders.append(name)
+            for d in gating:
+                print(f"STATIC CHECK {name}: {d.code} at {d.path}: "
+                      f"{d.message}")
+    return offenders
+
+
 def run_one_query(session: Session, query: str, query_name: str,
                   output_path: Optional[str], output_format: str) -> None:
     result = session.sql(query)
@@ -248,6 +293,18 @@ def run_query_stream(args) -> None:
     if args.sub_queries:
         query_dict = get_query_subset(query_dict,
                                       args.sub_queries.split(","))
+
+    if getattr(args, "static_check", False):
+        with obs.span("static_check", cat="phase"):
+            offenders = static_check(
+                sess, query_dict, args.engine,
+                scale_factor=getattr(args, "scale_factor", None))
+        if offenders:
+            raise SystemExit(
+                "static check failed: query part(s) "
+                f"{', '.join(offenders)} cannot lower on "
+                f"{args.engine} (diagnostics above); fix the query "
+                "or drop --static_check to run with runtime fallback")
 
     # concurrent-stream admission: at most N streams execute on the
     # device at once (the concurrentGpuTasks analog; set by the
@@ -491,7 +548,15 @@ def run_query_stream(args) -> None:
                     q["query"], q["wall_s"], q["compile_s"],
                     q["execute_s"], engine=args.engine,
                     scale_factor=run_scale_factor, seed=run_seed,
-                    source=os.path.basename(args.time_log))
+                    source=os.path.basename(args.time_log),
+                    # why the engine left the device path, as
+                    # "NDSxxx:Node" analyzer codes (engine-annotated)
+                    extra={k: v for k, v in {
+                        "fallback_codes":
+                            (q.get("attrs") or {}).get("fallback_codes"),
+                        "spmd_fallback":
+                            (q.get("attrs") or {}).get("spmd_fallback"),
+                    }.items() if v})
                     for q in qsums
                     if not (q.get("attrs") or {}).get("error")]
                 led.append(entries)
@@ -587,6 +652,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the bench driver passes the resolved seed)")
     p.add_argument("--floats", action="store_true",
                    help="double mode (no decimals)")
+    p.add_argument("--static_check", action="store_true",
+                   help="run the static plan analyzer over the stream "
+                        "before executing anything; on accel engines, "
+                        "reject queries with error-severity lowering "
+                        "diagnostics (code + plan path printed) before "
+                        "any compile")
     return p
 
 

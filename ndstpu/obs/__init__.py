@@ -23,7 +23,7 @@ from ndstpu.obs.trace import NULL_SPAN, Span, Tracer, env_enabled
 
 __all__ = [
     "Tracer", "Span", "NULL_SPAN", "env_enabled", "tracer", "enabled",
-    "span", "record", "add_time", "inc", "set_gauge",
+    "span", "record", "add_time", "annotate", "inc", "set_gauge",
     "counters_snapshot", "gauges_snapshot", "counter_delta",
     "export_jsonl", "export_chrome", "export_run", "run_metrics",
     "reset",
@@ -61,6 +61,10 @@ def record(name: str, cat: str, t0_epoch: float, wall_s: float,
 
 def add_time(bucket: str, seconds: float) -> None:
     _TRACER.add_time(bucket, seconds)
+
+
+def annotate(**attrs) -> None:
+    _TRACER.annotate(**attrs)
 
 
 def inc(name: str, value: float = 1) -> None:

@@ -200,6 +200,19 @@ class Tracer:
         if coll is not None and coll.buckets is not None:
             coll.buckets[bucket] = coll.buckets.get(bucket, 0.0) + seconds
 
+    def annotate(self, **attrs) -> None:
+        """Attach attributes to the innermost collector span on this
+        thread (or the process fallback) without opening a span — e.g.
+        the engine tagging the enclosing query span with the diagnostic
+        code of a runtime fallback.  Last write per key wins; they
+        surface in ``query_summaries()`` under ``attrs``."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        coll = stack[-1].collector if stack else self._fallback_collector
+        if coll is not None:
+            coll.attrs.update(attrs)
+
     # -- instruments ----------------------------------------------------------
 
     def inc(self, name: str, value: float = 1) -> None:

@@ -44,6 +44,7 @@ from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ndstpu import obs
+from ndstpu.analysis import lowering as lowreg
 from ndstpu.engine import columnar, expr as ex, physical, plan as lp
 from ndstpu.engine.columnar import BOOL, FLOAT64, INT64, Column, Table
 from ndstpu.engine.jaxexec import (
@@ -61,13 +62,22 @@ from ndstpu.parallel.mesh import SHARD_AXIS
 
 
 class DistUnsupported(Exception):
-    """Plan shape outside the distributed subset — fall back single-chip."""
+    """Plan shape outside the distributed subset — fall back single-chip.
+
+    ``code`` is the static analyzer's NDS3xx diagnostic for raise sites
+    it models (ndstpu/analysis/diagnostics.py); data-dependent guards
+    (dup runs, key-domain overflow, shuffle drops) stay uncoded."""
+
+    def __init__(self, msg: str, code=None):
+        super().__init__(msg)
+        self.code = code
 
 
 _SPINE_NODES = (lp.Scan, lp.Filter, lp.Project, lp.Join, lp.SubqueryAlias)
-_KEY_KINDS = ("int32", "int64", "date")
-_AGG_FUNCS = ("sum", "count", "avg", "min", "max",
-              "stddev_samp", "var_samp", "stddev", "variance")
+# shardable key kinds and decomposable aggregates come from the shared
+# supported-op registry so the static analyzer (NDS3xx) cannot drift
+_KEY_KINDS = tuple(sorted(lowreg.SPMD_KEY_KINDS))
+_AGG_FUNCS = tuple(sorted(lowreg.SPMD_AGG_FUNCS))
 
 
 @dataclasses.dataclass
@@ -131,7 +141,7 @@ class DistributedPlanExecutor:
     """Compiles + runs one logical plan over the mesh (one-shot object)."""
 
     def __init__(self, catalog, mesh, shard_threshold_rows: int = 65536,
-                 broadcast_limit_rows: int = 8_000_000,
+                 broadcast_limit_rows: int = lowreg.SPMD_BROADCAST_LIMIT_ROWS,
                  dev_cache: Optional[dict] = None,
                  chunk_rows: Optional[int] = None):
         self.catalog = catalog
@@ -177,7 +187,8 @@ class DistributedPlanExecutor:
             return offload
         scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
         if not scans:
-            raise DistUnsupported("no base-table scan in plan")
+            raise DistUnsupported("no base-table scan in plan",
+                                  code="NDS301")
         sized = sorted(((self.catalog.get(n.table).num_rows, i, n)
                         for i, n in enumerate(scans)),
                        key=lambda t: (-t[0], t[1]))
@@ -197,7 +208,8 @@ class DistributedPlanExecutor:
                 continue
             self._spine, self._top = spine, top
             return self._finish(result)
-        raise last or DistUnsupported("no sharded-size table in plan")
+        raise last or DistUnsupported("no sharded-size table in plan",
+                                      code="NDS301")
 
     def _try_subquery_offload(self, plan: lp.Plan) -> Optional[Table]:
         """q9 shape: the outer plan scans only sub-threshold tables (its
@@ -270,7 +282,8 @@ class DistributedPlanExecutor:
         self._emit_partials = True
         scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
         if not scans:
-            raise DistUnsupported("no base-table scan in branch")
+            raise DistUnsupported("no base-table scan in branch",
+                                  code="NDS301")
         sized = sorted(((self.catalog.get(n.table).num_rows, i, n)
                         for i, n in enumerate(scans)),
                        key=lambda t: (-t[0], t[1]))
@@ -293,7 +306,8 @@ class DistributedPlanExecutor:
                 continue
             self._spine, self._top = spine, top
             return out
-        raise last or DistUnsupported("no sharded-size table in branch")
+        raise last or DistUnsupported("no sharded-size table in branch",
+                                      code="NDS301")
 
     def _run_spine_retrying(self, spine: lp.Plan) -> Table:
         """Run the spine; if a shuffle-join receive bucket overflowed
@@ -648,7 +662,8 @@ class DistributedPlanExecutor:
             for f2, ct2, _ in bmetas[1:]:
                 if f2 != func or not compatible(ct2):
                     raise DistUnsupported(
-                        "union branches disagree on aggregate type")
+                        "union branches disagree on aggregate type",
+                        code="NDS302")
             dicts = [m[li][2] for _, _, m in parts]
             has_dict = any(d is not None for d in dicts)
             merged_dict = None
@@ -736,7 +751,8 @@ class DistributedPlanExecutor:
                 for nd in spine.walk()):
             # a pass-through row spine (bare scan/project) would shard
             # the fact only to ship every row straight back to the host
-            raise DistUnsupported("row spine does no distributed work")
+            raise DistUnsupported("row spine does no distributed work",
+                                  code="NDS306")
         top = plan if spine is not plan else None
         return spine, top
 
@@ -745,18 +761,22 @@ class DistributedPlanExecutor:
             for sub in e.walk():
                 if isinstance(sub, ex.AggExpr):
                     if sub.func not in _AGG_FUNCS:
-                        raise DistUnsupported(f"agg {sub.func} on spine")
+                        raise DistUnsupported(f"agg {sub.func} on spine",
+                                              code="NDS302")
                     if sub.distinct and (isinstance(sub.arg, ex.Star)
                                          or sub.arg is None):
-                        raise DistUnsupported("distinct star agg")
+                        raise DistUnsupported("distinct star agg",
+                                              code="NDS302")
                     if sub.distinct and node.grouping_sets is not None:
                         # a distinct count at the finest grouping cannot
                         # be re-combined into coarser rollup groups (the
                         # same value can occur under many fine groups)
                         raise DistUnsupported(
-                            "distinct agg under grouping sets")
+                            "distinct agg under grouping sets",
+                            code="NDS302")
                 if isinstance(sub, ex.WindowExpr):
-                    raise DistUnsupported("window inside aggregate")
+                    raise DistUnsupported("window inside aggregate",
+                                          code="NDS302")
 
     # -- spine preparation ---------------------------------------------------
 
@@ -793,16 +813,16 @@ class DistributedPlanExecutor:
             if not (on_left or on_right):
                 return False
             kind = p.kind
-            if kind not in ("inner", "left", "semi", "anti",
-                            "nullaware_anti", "mark"):
-                raise DistUnsupported(f"{kind} join on spine")
+            if kind not in lowreg.SPMD_SPINE_JOIN_KINDS:
+                raise DistUnsupported(f"{kind} join on spine", code="NDS303")
             keys = list(p.keys)
             if not keys:
-                raise DistUnsupported("non-equi join on spine")
+                raise DistUnsupported("non-equi join on spine", code="NDS304")
             if not on_left:
                 if kind != "inner":
                     raise DistUnsupported(
-                        f"sharded table on the build side of {kind} join")
+                        f"sharded table on the build side of {kind} join",
+                        code="NDS303")
                 keys = [(r, l) for l, r in keys]
             build_plan = p.right if on_left else p.left
             build = self.np_exec.execute(build_plan)
@@ -820,7 +840,8 @@ class DistributedPlanExecutor:
                     # host metadata at trace time)
                     if c.dictionary is None:
                         raise DistUnsupported(
-                            "string join key without dictionary")
+                            "string join key without dictionary",
+                            code="NDS307")
                     key_parts.append(c.data.astype(np.int64))
                     key_dicts.append(c.dictionary)
                     fixed_spans.append((0, len(c.dictionary) + 1))
@@ -830,7 +851,8 @@ class DistributedPlanExecutor:
                     fixed_spans.append(None)
                 else:
                     raise DistUnsupported(
-                        f"{c.ctype.kind} join key on spine")
+                        f"{c.ctype.kind} join key on spine",
+                        code="NDS307")
                 bvalid &= c.validity()
             bkeys = np.zeros(build.num_rows, dtype=np.int64)
             radices: List[Tuple[int, int]] = []
@@ -1296,7 +1318,8 @@ class DistributedPlanExecutor:
             if kd is not None:
                 if c.ctype.kind != "string" or c.dictionary is None:
                     raise DistUnsupported("string key against "
-                                          f"{c.ctype.kind} probe")
+                                          f"{c.ctype.kind} probe",
+                                          code="NDS307")
                 np_dict = c.dictionary
                 if len(np_dict) and len(kd):
                     pos = np.searchsorted(kd, np_dict)
@@ -1311,7 +1334,8 @@ class DistributedPlanExecutor:
                                  max(len(np_dict) - 1, 0))
                 part = jnp.asarray(mapping)[codes]
             elif c.ctype.kind not in _KEY_KINDS:
-                raise DistUnsupported(f"{c.ctype.kind} probe key")
+                raise DistUnsupported(f"{c.ctype.kind} probe key",
+                                      code="NDS307")
             else:
                 part = c.data.astype(jnp.int64)
             pnull |= ~c.valid
@@ -1934,7 +1958,8 @@ class DistributedPlanExecutor:
         if isinstance(e, ex.AggExpr):
             # an aggregate leaf the collection pass missed — bail to the
             # single-chip path rather than crash at finalize
-            raise DistUnsupported("unlowered aggregate in output expr")
+            raise DistUnsupported("unlowered aggregate in output expr",
+                                  code="NDS302")
         return e
 
     def _finalize_leaf(self, a: ex.AggExpr, meta, parts) -> Column:

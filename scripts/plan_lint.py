@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Corpus-wide static plan lint.
+
+Sweeps every query part of the power corpus through the static analyzer
+(ndstpu/analysis/) — parse → plan → optimize over a ZERO-ROW schema
+catalog, so no warehouse, no data, no jax — and emits:
+
+* ``PLAN_LINT.json`` / ``PLAN_LINT.md`` (repo root): every NDS1xx/2xx/3xx
+  diagnostic plus the per-part device-vs-fallback verdict.  Both are
+  deterministic (no timestamps) so committed copies only change when the
+  plans or the analyzer change.
+* With ``--baseline [PATH]``: exit nonzero iff a diagnostic is NOT in the
+  committed baseline (docs/plan_lint_baseline.json) — the CI gate fails
+  only on *new* findings.
+* With ``--write-baseline``: regenerate the baseline from this sweep.
+
+Usage:
+    python scripts/plan_lint.py                      # artifacts only
+    python scripts/plan_lint.py --baseline           # CI gate
+    python scripts/plan_lint.py --write-baseline     # accept current set
+"""
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / "docs" / "plan_lint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                    default=None, metavar="PATH",
+                    help="gate against this baseline (default: "
+                         "docs/plan_lint_baseline.json); exit 1 on new "
+                         "diagnostics")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from this sweep")
+    ap.add_argument("--json", default=str(REPO / "PLAN_LINT.json"))
+    ap.add_argument("--md", default=str(REPO / "PLAN_LINT.md"))
+    ap.add_argument("--rngseed", default="07291122510",
+                    help="stream seed (pinned bench seed by default so "
+                         "the artifact is reproducible)")
+    ap.add_argument("--stream", type=int, default=0)
+    ap.add_argument("--scale_factor", type=float, default=1.0,
+                    help="scale factor for overflow advisories (NDS103)")
+    ap.add_argument("--sub_queries", default=None,
+                    help="comma-separated query-part subset")
+    return ap
+
+
+def run_lint(args) -> int:
+    from ndstpu import analysis
+    from ndstpu.analysis import diagnostics as diag_mod
+    from ndstpu.engine.session import Session
+    from ndstpu.queries import streamgen
+
+    sess = Session(analysis.schema_catalog())
+    tables = analysis.schema_tables()
+    subset = set(args.sub_queries.split(",")) if args.sub_queries else None
+
+    diags, verdicts = [], {}
+    for name, sql in streamgen.render_power_corpus(
+            rngseed=args.rngseed, stream=args.stream):
+        if subset is not None and name not in subset:
+            continue
+        res = analysis.analyze_sql(sess, name, sql, tables=tables,
+                                   scale_factor=args.scale_factor)
+        verdicts[name] = res.verdict
+        diags.extend(res.diagnostics)
+
+    meta = {
+        "rngseed": args.rngseed,
+        "stream": args.stream,
+        "scale_factor": args.scale_factor,
+        "parts": len(verdicts),
+        "device": sum(1 for v in verdicts.values() if v == "device"),
+        "fallback": sorted(q for q, v in verdicts.items()
+                           if v == "fallback"),
+    }
+    pathlib.Path(args.json).write_text(diag_mod.to_json(diags, meta))
+    pathlib.Path(args.md).write_text(diag_mod.to_markdown(diags, meta))
+    print(f"plan-lint: {meta['parts']} parts, {meta['device']} device, "
+          f"{len(meta['fallback'])} fallback, {len(diags)} diagnostics "
+          f"-> {args.json}")
+
+    if args.write_baseline:
+        DEFAULT_BASELINE.write_text(diag_mod.baseline_dump(diags))
+        print(f"plan-lint: baseline rewritten -> {DEFAULT_BASELINE}")
+
+    if args.baseline is not None:
+        bpath = pathlib.Path(args.baseline)
+        if not bpath.exists():
+            print(f"plan-lint: baseline {bpath} missing "
+                  "(run --write-baseline)", file=sys.stderr)
+            return 2
+        accepted = diag_mod.baseline_load(bpath.read_text())
+        new = diag_mod.new_against_baseline(diags, accepted)
+        if new:
+            print(f"plan-lint: {len(new)} diagnostic(s) not in baseline:",
+                  file=sys.stderr)
+            for d in new:
+                print(f"  {d.query} {d.code} [{d.severity}] {d.path}: "
+                      f"{d.message}", file=sys.stderr)
+            return 1
+        print(f"plan-lint: clean against baseline "
+              f"({len(accepted)} accepted)")
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_lint(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

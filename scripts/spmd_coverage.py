@@ -64,7 +64,10 @@ def rows_match(want, got, eps=1e-5):
 
 
 def run_corpus(catalog, mesh, shard_threshold_rows=500, verbose=True):
-    """(ok, mismatched, fell) lists over every corpus part."""
+    """(ok, mismatched, fell) lists over every corpus part.  Fallbacks
+    carry the NDS3xx diagnostic code of the DistUnsupported raise site
+    (the shared registry in ndstpu/analysis/lowering.py names them),
+    so the per-reason summary groups by analyzer code."""
     from ndstpu.engine import physical
     from ndstpu.engine.session import Session
     from ndstpu.parallel import dplan
@@ -73,45 +76,45 @@ def run_corpus(catalog, mesh, shard_threshold_rows=500, verbose=True):
     sess = Session(catalog, backend="cpu")
     dev_cache: dict = {}
     ok, mism, fell = [], [], []
-    for tpl in streamgen.list_templates():
-        for name, sql in streamgen.render_template_parts(
-                str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
-            try:
-                plan, _ = sess.plan(sql)
-            except Exception as e:  # planner issue, not a dist gap
-                fell.append((name, f"PLAN: {e}"))
-                continue
-            try:
-                want = physical.execute(plan, catalog).to_rows()
-            except Exception as e:  # oracle (numpy interpreter) defect
-                fell.append((name, f"ORACLE: {type(e).__name__}: {e}"))
-                continue
-            try:
-                exe = dplan.DistributedPlanExecutor(
-                    catalog, mesh,
-                    shard_threshold_rows=shard_threshold_rows,
-                    dev_cache=dev_cache)
-                got = exe.execute_plan(plan).to_rows()
-            except dplan.DistUnsupported as e:
-                fell.append((name, str(e)))
-                if verbose:
-                    print(f"  FALL {name}: {e}", flush=True)
-                continue
-            except Exception as e:
-                fell.append((name, f"ERROR {type(e).__name__}: {e}"))
-                if verbose:
-                    print(f"  ERR  {name}: {type(e).__name__}: {e}",
-                          flush=True)
-                continue
-            if rows_match(want, got):
-                ok.append(name)
-                if verbose:
-                    print(f"  OK   {name} ({len(got)} rows)", flush=True)
-            else:
-                mism.append((name, len(want), len(got)))
-                if verbose:
-                    print(f"  ROWDIFF {name}: {len(want)} vs {len(got)}",
-                          flush=True)
+    for name, sql in streamgen.render_power_corpus(
+            rngseed="07291122510", stream=0):
+        try:
+            plan, _ = sess.plan(sql)
+        except Exception as e:  # planner issue, not a dist gap
+            fell.append((name, f"PLAN: {e}"))
+            continue
+        try:
+            want = physical.execute(plan, catalog).to_rows()
+        except Exception as e:  # oracle (numpy interpreter) defect
+            fell.append((name, f"ORACLE: {type(e).__name__}: {e}"))
+            continue
+        try:
+            exe = dplan.DistributedPlanExecutor(
+                catalog, mesh,
+                shard_threshold_rows=shard_threshold_rows,
+                dev_cache=dev_cache)
+            got = exe.execute_plan(plan).to_rows()
+        except dplan.DistUnsupported as e:
+            code = getattr(e, "code", None) or "uncoded"
+            fell.append((name, f"{code}: {e}"))
+            if verbose:
+                print(f"  FALL {name}: {code}: {e}", flush=True)
+            continue
+        except Exception as e:
+            fell.append((name, f"ERROR {type(e).__name__}: {e}"))
+            if verbose:
+                print(f"  ERR  {name}: {type(e).__name__}: {e}",
+                      flush=True)
+            continue
+        if rows_match(want, got):
+            ok.append(name)
+            if verbose:
+                print(f"  OK   {name} ({len(got)} rows)", flush=True)
+        else:
+            mism.append((name, len(want), len(got)))
+            if verbose:
+                print(f"  ROWDIFF {name}: {len(want)} vs {len(got)}",
+                      flush=True)
     return ok, mism, fell
 
 

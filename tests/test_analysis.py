@@ -1,0 +1,295 @@
+"""Static plan analyzer tests (ndstpu/analysis/): per-operator schema
+inference, diagnostic emission (NDS1xx/2xx/3xx), golden diagnostics for
+corpus queries, baseline gating, the plan_lint CLI, and the power-run
+--static_check gate.  Everything here runs on a ZERO-ROW schema catalog
+— no warehouse, no data execution."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ndstpu import analysis, obs
+from ndstpu.analysis import diagnostics as diag_mod
+from ndstpu.analysis.diagnostics import Diagnostic
+from ndstpu.engine import plan as lp
+from ndstpu.engine.columnar import FLOAT64, INT64
+from ndstpu.engine.planner import PlanError
+from ndstpu.engine.session import Session
+from ndstpu.queries import streamgen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def sess():
+    return Session(analysis.schema_catalog())
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return analysis.schema_tables()
+
+
+def analyze(sess, tables, sql, **kw):
+    return analysis.analyze_sql(sess, "q", sql, tables=tables, **kw)
+
+
+def codes(res):
+    return [d.code for d in res.diagnostics]
+
+
+# -- schema inference ------------------------------------------------------
+
+def test_project_expression_types(sess, tables):
+    res = analyze(sess, tables,
+                  "select ss_item_sk, ss_quantity / 2 as r, "
+                  "ss_ext_sales_price * ss_ext_sales_price as m, "
+                  "ss_item_sk is null as b from store_sales")
+    cols = dict(res.schema.cols)
+    assert cols["ss_item_sk"].kind == "int32"
+    # SQL division is float64 regardless of operand types
+    assert cols["r"].ctype == FLOAT64
+    # decimal * decimal widens to precision 38, scale ls+rs
+    m = cols["m"].ctype
+    assert (m.kind, m.precision, m.scale) == ("decimal", 38, 4)
+    assert cols["b"].kind == "bool" and not cols["b"].nullable
+
+
+def test_aggregate_result_types(sess, tables):
+    res = analyze(sess, tables,
+                  "select count(*) as c, sum(ss_quantity) as s, "
+                  "avg(ss_ext_sales_price) as a, min(i_item_id) as m "
+                  "from store_sales join item on ss_item_sk = i_item_sk "
+                  "group by i_category")
+    cols = dict(res.schema.cols)
+    assert cols["c"].ctype == INT64 and not cols["c"].nullable
+    assert cols["s"].ctype == INT64 and cols["s"].nullable
+    assert cols["a"].ctype == FLOAT64
+    assert cols["m"].kind == "string"   # min keeps char(16), not bare STRING
+    assert res.verdict == "device"
+
+
+def test_outer_join_nullability(sess, tables):
+    res = analyze(sess, tables,
+                  "select ss_item_sk, sr_return_quantity from store_sales "
+                  "left join store_returns on ss_ticket_number = "
+                  "sr_ticket_number and ss_item_sk = sr_item_sk")
+    cols = dict(res.schema.cols)
+    # the preserved side keeps its nullability; the other side becomes
+    # nullable through the outer join
+    assert cols["sr_return_quantity"].nullable
+
+
+# -- NDS1xx typing diagnostics ---------------------------------------------
+
+def test_lossy_cast_flagged(sess, tables):
+    res = analyze(sess, tables,
+                  "select cast(ss_ext_sales_price as int) as v "
+                  "from store_sales")
+    assert "NDS102" in codes(res)
+    d = next(d for d in res.diagnostics if d.code == "NDS102")
+    assert d.severity == "warning" and d.path  # anchored to a plan node
+    assert res.verdict == "device"             # warnings never gate
+
+
+def test_join_key_type_mismatch_flagged(sess, tables):
+    res = analyze(sess, tables,
+                  "select ss_item_sk from store_sales "
+                  "join item on ss_item_sk = i_item_id")
+    assert "NDS101" in codes(res)
+    d = next(d for d in res.diagnostics if d.code == "NDS101")
+    assert "/keys[" in d.path
+
+
+def test_setop_mismatch_flagged(sess, tables):
+    res = analyze(sess, tables,
+                  "select ss_item_sk from store_sales "
+                  "union all select i_item_id from item")
+    assert "NDS104" in codes(res)
+
+
+def test_underspecified_sort_flagged(sess, tables):
+    res = analyze(sess, tables,
+                  "select ss_item_sk, ss_quantity from store_sales "
+                  "order by ss_item_sk limit 5")
+    assert "NDS105" in codes(res)
+    # a fully keyed sort is quiet
+    res2 = analyze(sess, tables,
+                   "select ss_item_sk, ss_quantity from store_sales "
+                   "order by ss_item_sk, ss_quantity limit 5")
+    assert "NDS105" not in codes(res2)
+
+
+def test_int32_overflow_scales_with_sf(sess, tables):
+    sql = "select sum(ss_item_sk) as s from store_sales"
+    assert "NDS103" not in codes(analyze(sess, tables, sql,
+                                         scale_factor=1.0))
+    res = analyze(sess, tables, sql, scale_factor=2000.0)
+    assert "NDS103" in codes(res)
+
+
+# -- NDS2xx lowering audit -------------------------------------------------
+
+def test_unsupported_function_gates_verdict(sess, tables):
+    res = analyze(sess, tables,
+                  "select upper(ss_item_sk) as u from store_sales")
+    assert res.verdict == "fallback"
+    assert "NDS206" in res.fallback_codes
+
+
+def test_keyless_outer_join_gates_verdict(tables):
+    plan = lp.Join(lp.Scan("store_sales", "store_sales"),
+                   lp.Scan("store_returns", "store_returns"),
+                   "full", [])
+    res = analysis.analyze_plan(plan, tables=tables, query="q")
+    assert res.verdict == "fallback"
+    assert "NDS210" in res.fallback_codes
+
+
+def test_subquery_fallback_does_not_gate(sess, tables):
+    # jaxexec isolates _used_fallback across subquery resolution, so an
+    # unsupported expression INSIDE a subquery must not flip the main
+    # plan's verdict
+    res = analyze(sess, tables,
+                  "select ss_item_sk from store_sales where ss_quantity "
+                  "> (select max(sr_return_quantity) from store_returns "
+                  "   where upper(sr_item_sk) = 'X')")
+    assert any(d.code == "NDS206" and "/subquery[" in d.path
+               for d in res.diagnostics)
+    assert res.verdict == "device"
+
+
+# -- golden corpus diagnostics ---------------------------------------------
+
+def corpus_part(name):
+    tpl = name.split("_part")[0] + ".tpl"
+    for n, sql in streamgen.render_template_parts(
+            str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
+        if n == name:
+            return sql
+    raise AssertionError(f"no corpus part {name}")
+
+
+def test_golden_query41_no_fact_scan(sess, tables):
+    res = analyze(sess, tables, corpus_part("query41"))
+    assert codes(res) == ["NDS301"]
+    assert res.verdict == "device"   # NDS3xx is advisory only
+
+
+def test_golden_query61_diagnostics(sess, tables):
+    res = analyze(sess, tables, corpus_part("query61"))
+    assert sorted(codes(res)) == ["NDS102", "NDS102", "NDS105", "NDS305"]
+
+
+# -- diagnostics plumbing --------------------------------------------------
+
+def test_baseline_roundtrip():
+    diags = [Diagnostic("NDS102", "m1", "Project", query="qa"),
+             Diagnostic("NDS210", "m2", "Join", query="qb")]
+    accepted = diag_mod.baseline_load(diag_mod.baseline_dump(diags))
+    assert diag_mod.new_against_baseline(diags, accepted) == []
+    extra = Diagnostic("NDS205", "m3", "Project", query="qa")
+    new = diag_mod.new_against_baseline(diags + [extra], accepted)
+    assert [d.code for d in new] == ["NDS205"]
+
+
+def test_json_and_markdown_emitters():
+    diags = [Diagnostic("NDS102", "lossy", "Project", query="qa")]
+    obj = json.loads(diag_mod.to_json(diags, {"parts": 1}))
+    assert obj["summary"]["by_code"] == {"NDS102": 1}
+    md = diag_mod.to_markdown(diags, {"parts": 1})
+    assert "NDS102" in md and "| qa |" in md
+
+
+def test_unknown_code_rejected():
+    with pytest.raises(ValueError):
+        Diagnostic("NDS999", "nope", "Project")
+
+
+# -- plan_lint CLI ---------------------------------------------------------
+
+def run_plan_lint(tmp_path, *extra):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "plan_lint.py"),
+         "--sub_queries", "query41,query61",
+         "--json", str(tmp_path / "PL.json"),
+         "--md", str(tmp_path / "PL.md"), *extra],
+        capture_output=True, text=True, env=env)
+
+
+def test_plan_lint_clean_against_committed_baseline(tmp_path):
+    r = run_plan_lint(tmp_path, "--baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+    obj = json.loads((tmp_path / "PL.json").read_text())
+    assert obj["meta"]["parts"] == 2
+    assert (tmp_path / "PL.md").exists()
+
+
+def test_plan_lint_missing_baseline_exits_2(tmp_path):
+    r = run_plan_lint(tmp_path, "--baseline", str(tmp_path / "nope.json"))
+    assert r.returncode == 2
+
+
+def test_plan_lint_new_diagnostic_exits_1(tmp_path):
+    empty = tmp_path / "empty.json"
+    empty.write_text(diag_mod.baseline_dump([]))
+    r = run_plan_lint(tmp_path, "--baseline", str(empty))
+    assert r.returncode == 1
+    assert "NDS" in r.stderr
+
+
+def test_committed_artifacts_current(sess, tables):
+    """The committed PLAN_LINT.json must match what the analyzer says
+    today for the queries it covers (spot-checked, not a full sweep —
+    CI's plan-lint step does the full gate)."""
+    obj = json.loads(open(os.path.join(REPO, "PLAN_LINT.json")).read())
+    want = sorted(d["code"] for d in obj["diagnostics"]
+                  if d["query"] == "query61")
+    res = analyze(sess, tables, corpus_part("query61"))
+    assert sorted(codes(res)) == want
+
+
+# -- power.py --static_check gate ------------------------------------------
+
+def test_static_check_gate(sess):
+    from ndstpu.harness import power
+    qd = {
+        "q_good": "select ss_item_sk, count(*) from store_sales "
+                  "group by ss_item_sk",
+        "q_planfail": "select ss_item_sk from store_sales full join "
+                      "store_returns on ss_ticket_number <> "
+                      "sr_ticket_number",
+        "q_lowerfail": "select upper(ss_item_sk) as u from store_sales",
+    }
+    off = power.static_check(sess, qd, "tpu")
+    assert off == ["q_planfail", "q_lowerfail"]
+    # the cpu interpreter executes everything: nothing gates
+    assert power.static_check(sess, qd, "cpu") == []
+
+
+# -- planner near-miss suggestions -----------------------------------------
+
+def test_unresolved_column_suggests_near_misses(sess):
+    with pytest.raises(PlanError, match="ss_item_sk"):
+        sess.plan("select ss_itm_sk from store_sales")
+    with pytest.raises(PlanError, match="ss_quantity"):
+        sess.plan("select s.ss_quantty from store_sales s")
+    # suggestions see the whole scope chain, including outer scopes
+    with pytest.raises(PlanError, match="did you mean"):
+        sess.plan("select ss_item_sk from store_sales where exists "
+                  "(select 1 from store_returns "
+                  " where sr_item_sk = ss_item_skk)")
+
+
+# -- obs annotation --------------------------------------------------------
+
+def test_annotate_reaches_query_summary():
+    tr = obs.tracer()
+    with tr.span("q_ann", cat="query", collect=True):
+        tr.annotate(fallback_codes="NDS206:Project")
+    qs = [q for q in tr.query_summaries() if q["query"] == "q_ann"]
+    assert qs and qs[-1]["attrs"]["fallback_codes"] == "NDS206:Project"

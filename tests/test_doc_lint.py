@@ -36,6 +36,15 @@ def test_bench_root_citations_checked(tmp_path):
     assert artifact_lint.lint_text(text, str(tmp_path)) == []
 
 
+def test_plan_lint_root_citations_checked(tmp_path):
+    text = "static verdicts in `PLAN_LINT.json` and `PLAN_LINT.md`\n"
+    findings = artifact_lint.lint_text(text, str(tmp_path))
+    assert len(findings) == 2
+    (tmp_path / "PLAN_LINT.json").write_text("{}")
+    (tmp_path / "PLAN_LINT.md").write_text("# lint\n")
+    assert artifact_lint.lint_text(text, str(tmp_path)) == []
+
+
 def test_config_mismatch_flagged_unless_stale(tmp_path):
     docs = tmp_path / "docs"
     docs.mkdir()

@@ -343,19 +343,35 @@ def test_compile_record_persistence(catalog, cpu_sess, tmp_path):
 
 def test_corpus_compile_coverage(catalog):
     """Most corpus templates must compile to single XLA programs (no
-    numpy fallback) — fallbacks are allowed but should be the minority."""
+    numpy fallback) — fallbacks are allowed but should be the minority.
+    The static analyzer's per-part verdict must agree with the runtime
+    outcome: its entire value is predicting device-vs-fallback without
+    executing anything."""
+    from ndstpu import analysis
     from ndstpu.engine.session import Session
     sess = Session(catalog, backend="tpu")
-    compiled, fallback = [], []
+    tables = analysis.schema_tables()
+    compiled, fallback, disagree = [], [], []
     for tpl in streamgen.list_templates():
         for name, sql in streamgen.render_template_parts(
                 str(streamgen.TEMPLATE_DIR / tpl), "07291122510", 0):
             sess.sql(sql)
             cp = sess.compiled_plan(sql)
-            (compiled if cp is not None and cp.compilable
-             else fallback).append(name)
+            ran_on_device = cp is not None and cp.compilable
+            (compiled if ran_on_device else fallback).append(name)
+            res = analysis.analyze_sql(sess, name, sql, tables=tables)
+            predicted_device = res.verdict == "device"
+            if predicted_device != ran_on_device:
+                disagree.append(
+                    (name, res.verdict,
+                     "device" if ran_on_device else "fallback",
+                     res.fallback_codes,
+                     getattr(cp, "fallback_codes", ())))
     assert not fallback, \
         f"corpus queries falling back to numpy: {fallback}"
+    assert not disagree, \
+        f"static verdict vs runtime (query, static, runtime, " \
+        f"static codes, runtime codes): {disagree}"
 
 
 def test_compiled_replay_path(catalog, cpu_sess):
