@@ -44,6 +44,7 @@ import os
 import dataclasses
 import math
 import re
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -1331,6 +1332,16 @@ class JaxExecutor:
         # re-run NO discovery and build NO new jitted programs
         self.n_discoveries = 0
         self.n_jit_builds = 0
+        # Thread-safety (inproc throughput scheduler): the executor
+        # keeps per-query mutable state (mode/_rec/_pos, subquery
+        # memos, eager segment tables), so query execution is
+        # serialized under _exec_lock (RLock: replay of a demoted
+        # segment re-enters execute_to_host).  _key_latch adds per-key
+        # "discover once, others wait": a second stream arriving for a
+        # text mid-compile blocks on the key, then hits _compiled.
+        from ndstpu.engine.latch import KeyedLatch
+        self._exec_lock = threading.RLock()
+        self._key_latch = KeyedLatch()
         # eager bounds diagnostic: plain (non-compiling) executors keep
         # it always on — they have no discovery phase to front-load the
         # check into; CompilingExecutor narrows it to discovery
@@ -1339,13 +1350,15 @@ class JaxExecutor:
     # -- public --------------------------------------------------------------
 
     def execute_to_host(self, p: lp.Plan) -> Table:
-        # per-query subquery memo: expr ids are only stable within one plan
-        self._subq_cache = {}
-        self._tree_cache = {}
-        self.np_exec = physical.Executor(self.catalog)
-        self.mode = "eager"
-        with host_compute():
-            return to_host(self.execute(p))
+        with self._exec_lock:
+            # per-query subquery memo: expr ids are only stable within
+            # one plan
+            self._subq_cache = {}
+            self._tree_cache = {}
+            self.np_exec = physical.Executor(self.catalog)
+            self.mode = "eager"
+            with host_compute():
+                return to_host(self.execute(p))
 
     # -- sync-point abstraction ----------------------------------------------
 
@@ -3139,6 +3152,17 @@ class CompilingExecutor(JaxExecutor):
         self.last_attribution: Optional[dict] = None
 
     def execute_cached(self, p: lp.Plan, key: str) -> Table:
+        # compile-once across concurrent streams: the key latch makes
+        # the first arrival for a text pay discovery while later
+        # arrivals block, then take the cache-hit replay path; the
+        # exec lock serializes the actual device execution (see
+        # JaxExecutor.__init__).  A failed discovery caches nothing
+        # and releases the latch, so it cannot poison other streams.
+        with self._key_latch.holding(key):
+            with self._exec_lock:
+                return self._execute_cached_locked(p, key)
+
+    def _execute_cached_locked(self, p: lp.Plan, key: str) -> Table:
         versions = tuple(sorted(
             getattr(self.catalog, "versions", {}).items()))
         cp = self._compiled.get(key)
@@ -3548,6 +3572,10 @@ class CompilingExecutor(JaxExecutor):
         text (the in-memory views-epoch prefix is process-local).
         Returns the record count."""
         import pickle
+        with self._exec_lock:
+            return self._save_compile_records_locked(path, pickle)
+
+    def _save_compile_records_locked(self, path: str, pickle) -> int:
         data = {"\x00fmt": self._REC_FORMAT, "\x00segments": {}}
         segstore = data["\x00segments"]
         for key, cp in self._compiled.items():
@@ -3619,6 +3647,12 @@ class CompilingExecutor(JaxExecutor):
         if not isinstance(data, dict) or \
                 data.get("\x00fmt") != self._REC_FORMAT:
             return 0
+        with self._exec_lock:
+            return self._load_compile_records_locked(
+                data, plan_for_key, key_prefix)
+
+    def _load_compile_records_locked(self, data, plan_for_key,
+                                     key_prefix: str) -> int:
         segstore = data.get("\x00segments", {})
         versions_now = tuple(sorted(
             getattr(self.catalog, "versions", {}).items()))

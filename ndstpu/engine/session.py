@@ -16,6 +16,20 @@ from ndstpu.engine import columnar, physical, planner as pl, plan as lp
 from ndstpu.engine.sql import ast, parse_statement, parse_statements
 
 
+class _NullCM:
+    """No-op lock stand-in for Session-like objects that predate the
+    __post_init__ lock set (e.g. unpickled from an old snapshot)."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CM = _NullCM()
+
+
 @dataclass
 class Session:
     catalog: object  # ndstpu.io.loader.Catalog
@@ -34,6 +48,31 @@ class Session:
     # bumped on view create/drop — part of the compiled-query cache key
     # (same SQL text over a redefined view must not reuse a stale plan)
     _views_epoch: int = 0
+
+    def __post_init__(self):
+        # Thread-safety contract (inproc throughput scheduler,
+        # ndstpu/harness/scheduler.py): N stream threads share one
+        # Session.  Three pieces make that sound:
+        #   _cache_lock — guards _plan_cache get/put and lazy
+        #       sub-object init (executor, spmd caches);
+        #   _plan_latch — per-query-text "plan once, others wait"
+        #       (ndstpu.engine.latch.KeyedLatch), so concurrent streams
+        #       never duplicate planning work and cache-hit counters
+        #       stay an honest compile-once proof;
+        #   _exec_lock  — serializes statement EXECUTION (and all
+        #       DDL/DML).  The executor keeps per-query mutable state
+        #       (discovery recorder, subquery memos) and the physical
+        #       device runs programs serially anyway, so statement-
+        #       granularity serialization loses no real parallelism;
+        #       cross-statement overlap happens at the admission gate.
+        # RLocks: CTAS/INSERT recurse into _run on the same thread.
+        import threading
+
+        from ndstpu.engine.latch import KeyedLatch
+        self._cache_lock = threading.RLock()
+        self._exec_lock = threading.RLock()
+        self._plan_latch = KeyedLatch()
+        self._plan_cache: Dict[str, tuple] = {}
 
     def sql(self, text: str) -> Optional[columnar.Table]:
         """Execute one statement; returns a Table for queries, None for DDL."""
@@ -67,45 +106,75 @@ class Session:
     def _run_traced(self, stmt: ast.Node,
                     key: Optional[str] = None
                     ) -> Optional[columnar.Table]:
-        from ndstpu import obs
         if isinstance(stmt, ast.Query):
-            # plan cache: a steady-state replay of a compiled query must
-            # not re-plan + re-optimize the SQL every call (50-150 ms of
-            # pure host overhead per execution on complex plans); keyed
-            # like the compiled-program cache (views epoch + text)
-            pc = getattr(self, "_plan_cache", None)
-            if pc is None:
-                pc = self._plan_cache = {}
-            ent = None
-            state = None
-            if key is not None:
-                # the key is the TEXT alone — one slot per query, with
-                # views epoch + catalog versions stored in the value
-                # and replace-on-mismatch (like _spmd_cache): DML or
-                # view churn must invalidate without stranding old-
-                # epoch entries forever
-                versions = tuple(sorted(
-                    getattr(self.catalog, "versions", {}).items()))
-                state = (self._views_epoch, versions)
-                ent = pc.get(key)
-                if ent is not None and ent[0] != state:
-                    ent = None
-                obs.inc("engine.cache.plan.hit" if ent is not None
-                        else "engine.cache.plan.miss")
-            if ent is None:
-                with obs.span("plan", cat="plan-node"):
-                    planner = pl.Planner(self.catalog, dict(self.views))
-                    plan, cols = planner.plan_query(stmt)
-                    from ndstpu.engine.optimizer import optimize
-                    plan = optimize(plan, self.catalog)
-                    # display names: strip alias qualifiers
-                    disp = self._dedupe(planner._display_names(cols))
-                if key is not None:
-                    pc[key] = (state, plan, disp)
-            else:
-                _s, plan, disp = ent
-            out = self._execute(plan, key=key)
+            plan, disp = self._plan_cached(stmt, key)
+            # execution serialized (see __post_init__): the executor's
+            # per-query mutable state is not safe under concurrent
+            # statements, and one device runs programs serially anyway
+            with self._exec_lock:
+                out = self._execute(plan, key=key)
             return columnar.Table(dict(zip(disp, out.columns.values())))
+        with self._exec_lock:
+            return self._run_ddl(stmt)
+
+    def _plan_cached(self, stmt: "ast.Query", key: Optional[str]):
+        """Plan + optimize with the text-keyed plan cache.
+
+        A steady-state replay of a compiled query must not re-plan +
+        re-optimize the SQL every call (50-150 ms of pure host overhead
+        per execution on complex plans).  The key is the TEXT alone —
+        one slot per query, with views epoch + catalog versions stored
+        in the value and replace-on-mismatch (like _spmd_cache): DML or
+        view churn must invalidate without stranding old-epoch entries
+        forever.  Under the per-key latch, concurrent streams plan each
+        distinct text exactly once: later arrivals block, then hit.
+        Planning itself is host-pure (reads catalog/views), so distinct
+        texts plan concurrently while the device executes.
+        """
+        from ndstpu import obs
+        pc = getattr(self, "_plan_cache", None)
+        if pc is None:
+            with getattr(self, "_cache_lock", _NULL_CM):
+                pc = getattr(self, "_plan_cache", None)
+                if pc is None:
+                    pc = self._plan_cache = {}
+        if key is None:
+            with obs.span("plan", cat="plan-node"):
+                plan, disp = self._plan_fresh(stmt)
+            return plan, disp
+        latch = getattr(self, "_plan_latch", None)
+        with (latch.holding(key) if latch is not None else _NULL_CM):
+            versions = tuple(sorted(
+                getattr(self.catalog, "versions", {}).items()))
+            state = (self._views_epoch, versions)
+            with getattr(self, "_cache_lock", _NULL_CM):
+                ent = pc.get(key)
+            if ent is not None and ent[0] != state:
+                ent = None
+            obs.inc("engine.cache.plan.hit" if ent is not None
+                    else "engine.cache.plan.miss")
+            if ent is not None:
+                _s, plan, disp = ent
+                return plan, disp
+            with obs.span("plan", cat="plan-node"):
+                plan, disp = self._plan_fresh(stmt)
+            # store only on success: a planner exception propagates
+            # with nothing cached (no poisoning), the latch releases
+            # in its finally, and the next arrival retries
+            with getattr(self, "_cache_lock", _NULL_CM):
+                pc[key] = (state, plan, disp)
+            return plan, disp
+
+    def _plan_fresh(self, stmt: "ast.Query"):
+        planner = pl.Planner(self.catalog, dict(self.views))
+        plan, cols = planner.plan_query(stmt)
+        from ndstpu.engine.optimizer import optimize
+        plan = optimize(plan, self.catalog)
+        # display names: strip alias qualifiers
+        disp = self._dedupe(planner._display_names(cols))
+        return plan, disp
+
+    def _run_ddl(self, stmt: ast.Node) -> Optional[columnar.Table]:
         if isinstance(stmt, ast.CreateView):
             planner = pl.Planner(self.catalog, dict(self.views))
             plan, cols = planner.plan_query(stmt.query)
@@ -293,11 +362,12 @@ class Session:
         Spark's cached TempViews + codegen cache).  Per-table invalidation
         happens inside the executor via catalog versions."""
         from ndstpu.engine import jaxexec
-        exe = getattr(self, "_jax_exec_cache", None)
-        if exe is None or exe.catalog is not self.catalog:
-            exe = jaxexec.CompilingExecutor(self.catalog)
-            self._jax_exec_cache = exe
-        return exe
+        with getattr(self, "_cache_lock", _NULL_CM):
+            exe = getattr(self, "_jax_exec_cache", None)
+            if exe is None or exe.catalog is not self.catalog:
+                exe = jaxexec.CompilingExecutor(self.catalog)
+                self._jax_exec_cache = exe
+            return exe
 
     # -- DML against the warehouse (ACID ndslake tables) ---------------------
 

@@ -78,6 +78,68 @@ class DeviceAdmission:
         self._fds = {}
 
 
+class InprocAdmission:
+    """In-process ``slots`` semantics of :class:`DeviceAdmission` for
+    the inproc throughput scheduler (ndstpu/harness/scheduler.py): the
+    stream workers are threads in ONE process, so a plain semaphore
+    replaces the lock files.  Tracks the observed concurrency peak and
+    per-acquisition device intervals — the committed evidence that at
+    most ``slots`` queries held the device at once."""
+
+    def __init__(self, slots: int):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        import threading
+        self.slots = slots
+        self._sem = threading.Semaphore(slots)
+        self._mu = threading.Lock()
+        self._tl = threading.local()
+        self._active = 0
+        self.max_active = 0
+        self.wait_s_total = 0.0
+        self.intervals = []  # (t_acquired, t_released) epoch pairs
+
+    def acquire(self) -> int:
+        t0 = time.time()
+        self._sem.acquire()
+        now = time.time()
+        with self._mu:
+            self._active += 1
+            self.max_active = max(self.max_active, self._active)
+            self.wait_s_total += now - t0
+        self._tl.t0 = now
+        return 0
+
+    def release(self) -> None:
+        t0 = getattr(self._tl, "t0", None)
+        self._tl.t0 = None
+        with self._mu:
+            self._active -= 1
+            if t0 is not None:
+                self.intervals.append((t0, time.time()))
+        self._sem.release()
+
+    @contextlib.contextmanager
+    def slot(self):
+        self.acquire()
+        try:
+            yield
+        finally:
+            self.release()
+
+    def device_timeline(self) -> dict:
+        """Admission-level overlap evidence for the overlap report."""
+        with self._mu:
+            ivs = list(self.intervals)
+        return {
+            "slots": self.slots,
+            "max_concurrent": self.max_active,
+            "gated_queries": len(ivs),
+            "busy_s_total": round(sum(b - a for a, b in ivs), 3),
+            "wait_s_total": round(self.wait_s_total, 3),
+        }
+
+
 def from_env() -> Optional[DeviceAdmission]:
     """Admission configured by the throughput runner via env vars
     (NDSTPU_ADMISSION_SLOTS / NDSTPU_ADMISSION_DIR), or None."""

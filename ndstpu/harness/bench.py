@@ -308,6 +308,11 @@ def run_full_bench(yaml_params: dict) -> None:
                     tcmd += ["--concurrent", str(t["concurrent"])]
                 if t.get("budget_s"):
                     tcmd += ["--budget_s", str(t["budget_s"])]
+                if t.get("mode"):
+                    # inproc = shared-engine fast path (one warehouse
+                    # load, compile-once across streams); process =
+                    # spec-faithful N-driver fan-out (default)
+                    tcmd += ["--mode", str(t["mode"])]
                 # overlap evidence artifact: proves the streams really
                 # ran concurrently under the admission cap
                 overlap = t.get("overlap_report") or \

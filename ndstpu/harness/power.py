@@ -234,6 +234,133 @@ def _dir_file_count(path: Optional[str]) -> int:
     return sum(len(files) for _, _, files in os.walk(path))
 
 
+def run_stream(query_dict, *, queue, runner,
+               heartbeat: Optional[progress.Heartbeat] = None,
+               engine: str = "cpu", app_id: Optional[str] = None,
+               stream_name: str = "stream",
+               engine_conf: Optional[Dict[str, str]] = None,
+               gate=None, pre_query=None,
+               json_summary_folder: Optional[str] = None,
+               summary_prefix: str = "",
+               xla_cache_dir: Optional[str] = None,
+               t0: Optional[float] = None,
+               span_attrs: Optional[dict] = None) -> dict:
+    """Run one query stream's per-query loop against an already-built
+    execution context.  This is the reusable core the power CLI and the
+    in-process throughput scheduler share: the CLI wraps it with its own
+    session/watchdog/admission setup (one stream per OS process), the
+    scheduler calls it once per stream THREAD against one shared session
+    (ndstpu/harness/scheduler.py).
+
+    * ``queue``      — BudgetedQueue or a scheduler stream view: needs
+      ``next(elapsed_s)``, ``projected_s()``, ``skipped``; an optional
+      ``done(name, failed=...)`` is called after each query (the
+      scheduler uses it to publish compile-once state across streams).
+    * ``runner``     — ``runner(sql, query_name)`` executes one query
+      (the CLI passes its watchdog-guarded closure).
+    * ``gate``       — admission with ``acquire()``/``release()``
+      (DeviceAdmission or InprocAdmission), or None.
+    * ``pre_query``  — optional hook returning a dict merged into the
+      query summary (the CLI's zombie-thread bookkeeping).
+
+    Returns ``{"app_id", "rows", "executed", "skipped", "failures",
+    "start_epoch_s", "end_epoch_s"}`` where ``rows`` are
+    ``(app_id, query, millis)`` time-log tuples.
+    """
+    t0 = time.time() if t0 is None else t0
+    app_id = app_id or f"ndstpu-{uuid.uuid4().hex[:12]}"
+    engine_conf = engine_conf or {}
+    mark_done = getattr(queue, "done", None)
+    rows: List[tuple] = []
+    executed: List[str] = []
+    failures = 0
+    start_epoch = time.time()
+    stream_span = obs.span(stream_name, cat="stream", collect=True,
+                           engine=engine, n_queries=len(query_dict),
+                           **(span_attrs or {}))
+    stream_span.__enter__()
+    try:
+        while True:
+            query_name = queue.next(time.time() - t0)
+            if query_name is None:
+                break
+            q_content = query_dict[query_name]
+            if heartbeat is not None:
+                heartbeat.beat(len(executed) + 1, query_name,
+                               time.time() - t0,
+                               eta_s=queue.projected_s())
+            print(f"====== Run {query_name} ======")
+            summary_extra = pre_query(query_name) if pre_query else None
+            xla_files_before = _dir_file_count(xla_cache_dir)
+            q_report = BenchReport(engine_conf)
+            # NOTE metric difference vs the reference: its
+            # concurrentGpuTasks semaphore is acquired inside task
+            # execution, so queue wait is part of each reported query
+            # time; here the gate sits outside report_on, so queryTimes
+            # is pure execution and the wait is reported separately
+            # (admissionWaitMs) to keep stream comparisons honest.
+            wait_ms = 0
+            if gate is not None:
+                wait_start = time.time()
+                gate.acquire()
+                wait_ms = int((time.time() - wait_start) * 1000)
+            try:
+                summary = q_report.report_on(runner, q_content,
+                                             query_name,
+                                             query_name=query_name,
+                                             span_attrs=span_attrs)
+            finally:
+                if gate is not None:
+                    gate.release()
+            if gate is not None:
+                summary["admissionWaitMs"] = wait_ms
+            if summary_extra:
+                summary.update(summary_extra)
+            failed = bool(summary["queryStatus"]) and \
+                summary["queryStatus"][-1] == "Failed"
+            if failed:
+                failures += 1
+            if mark_done is not None:
+                mark_done(query_name, failed=failed)
+            if xla_cache_dir:
+                xla_files_after = _dir_file_count(xla_cache_dir)
+                obs.set_gauge("xla.persistent_cache.files",
+                              xla_files_after)
+                if xla_files_after > xla_files_before:
+                    obs.inc("xla.persistent_cache.new_entries",
+                            xla_files_after - xla_files_before)
+                if summary.get("metrics"):
+                    summary["metrics"][-1]["xla_cache_files"] = {
+                        "before": xla_files_before,
+                        "after": xla_files_after}
+            print(f"Time taken: {summary['queryTimes']} millis for "
+                  f"{query_name}")
+            rows.append((app_id, query_name, summary["queryTimes"][0]))
+            if json_summary_folder:
+                q_report.write_summary(query_name,
+                                       prefix=summary_prefix)
+            executed.append(query_name)
+    finally:
+        stream_span.__exit__(None, None, None)
+    if queue.skipped:
+        budget = getattr(queue, "budget_s", None)
+        print(f"WARNING: {getattr(queue, 'phase', 'run')} run partial "
+              f"- {len(queue.skipped)} queries cut by the "
+              f"{budget:g}s budget; per-query partial_reason recorded "
+              f"in the metrics sidecar" if budget else
+              f"WARNING: {len(queue.skipped)} queries skipped")
+        obs.inc("harness.budget.queries_skipped", len(queue.skipped))
+    return {
+        "app_id": app_id,
+        "rows": rows,
+        "executed": executed,
+        "skipped": dict(queue.skipped),
+        "failures": failures,
+        "start_epoch_s": start_epoch,
+        "end_epoch_s": time.time(),
+    }
+
+
 def run_query_stream(args) -> None:
     total_start = time.time()
     execution_times = []
@@ -423,78 +550,37 @@ def run_query_stream(args) -> None:
                                    phase="power")
     hb = progress.Heartbeat("power", total=len(query_dict),
                             budget_s=budget_s)
-    executed: List[str] = []
 
-    power_start = int(time.time())
-    stream_span = obs.span(stream_name, cat="stream", collect=True,
-                           engine=args.engine, n_queries=len(query_dict))
-    stream_span.__enter__()
-    while True:
-        query_name = queue.next(time.time() - total_start)
-        if query_name is None:
-            break
-        q_content = query_dict[query_name]
-        hb.beat(len(executed) + 1, query_name,
-                time.time() - total_start, eta_s=queue.projected_s())
-        print(f"====== Run {query_name} ======")
+    def pre_query(query_name):
         # abandoned-thread gate: give zombies a short grace window to
         # drain before sharing the device with the next query
         active_zombies = live_zombies(grace_s=10.0) if zombies else []
-        if active_zombies:
-            print(f"WARNING: abandoned query threads still running: "
-                  f"{active_zombies} — device contention possible; "
-                  f"captured warnings may belong to them")
-        xla_files_before = _dir_file_count(args.xla_cache_dir)
-        q_report = BenchReport(engine_conf)
-        # NOTE metric difference vs the reference: its concurrentGpuTasks
-        # semaphore is acquired inside task execution, so queue wait is
-        # part of each reported query time; here the gate sits outside
-        # report_on, so queryTimes is pure execution and the wait is
-        # reported separately (admissionWaitMs) to keep stream
-        # comparisons honest.
-        wait_ms = 0
-        if gate is not None:
-            wait_start = time.time()
-            gate.acquire()
-            wait_ms = int((time.time() - wait_start) * 1000)
-        try:
-            summary = q_report.report_on(run_guarded, q_content,
-                                         query_name,
-                                         query_name=query_name)
-        finally:
-            if gate is not None:
-                gate.release()
-        if gate is not None:
-            summary["admissionWaitMs"] = wait_ms
-        if active_zombies:
-            summary["zombieQueries"] = active_zombies
-        if args.xla_cache_dir:
-            xla_files_after = _dir_file_count(args.xla_cache_dir)
-            obs.set_gauge("xla.persistent_cache.files", xla_files_after)
-            if xla_files_after > xla_files_before:
-                obs.inc("xla.persistent_cache.new_entries",
-                        xla_files_after - xla_files_before)
-            if summary.get("metrics"):
-                summary["metrics"][-1]["xla_cache_files"] = {
-                    "before": xla_files_before, "after": xla_files_after}
-        print(f"Time taken: {summary['queryTimes']} millis for {query_name}")
-        execution_times.append((app_id, query_name,
-                                summary["queryTimes"][0]))
-        if args.json_summary_folder:
-            if args.property_file:
-                prefix = os.path.join(
-                    args.json_summary_folder,
-                    os.path.basename(args.property_file).split(".")[0])
-            else:
-                prefix = os.path.join(args.json_summary_folder, "")
-            q_report.write_summary(query_name, prefix=prefix)
-        executed.append(query_name)
-    stream_span.__exit__(None, None, None)
-    if queue.skipped:
-        print(f"WARNING: power run partial - {len(queue.skipped)} "
-              f"queries cut by the {budget_s:g}s budget; per-query "
-              f"partial_reason recorded in the metrics sidecar")
-        obs.inc("harness.budget.queries_skipped", len(queue.skipped))
+        if not active_zombies:
+            return None
+        print(f"WARNING: abandoned query threads still running: "
+              f"{active_zombies} — device contention possible; "
+              f"captured warnings may belong to them")
+        return {"zombieQueries": active_zombies}
+
+    if args.json_summary_folder and args.property_file:
+        summary_prefix = os.path.join(
+            args.json_summary_folder,
+            os.path.basename(args.property_file).split(".")[0])
+    else:
+        summary_prefix = os.path.join(args.json_summary_folder or "", "")
+
+    power_start = int(time.time())
+    res = run_stream(query_dict, queue=queue, runner=run_guarded,
+                     heartbeat=hb, engine=args.engine, app_id=app_id,
+                     stream_name=stream_name, engine_conf=engine_conf,
+                     gate=gate, pre_query=pre_query,
+                     json_summary_folder=args.json_summary_folder,
+                     summary_prefix=summary_prefix,
+                     xla_cache_dir=args.xla_cache_dir,
+                     t0=total_start,
+                     span_attrs={"stream": stream_name})
+    execution_times.extend(res["rows"])
+    executed = res["executed"]
     power_end = int(time.time())
     power_elapse = int((power_end - power_start) * 1000)
     total_elapse = int((time.time() - total_start) * 1000)
@@ -550,8 +636,11 @@ def run_query_stream(args) -> None:
                     scale_factor=run_scale_factor, seed=run_seed,
                     source=os.path.basename(args.time_log),
                     # why the engine left the device path, as
-                    # "NDSxxx:Node" analyzer codes (engine-annotated)
+                    # "NDSxxx:Node" analyzer codes (engine-annotated);
+                    # plus the stream tag so a shared ledger stays
+                    # attributable per stream
                     extra={k: v for k, v in {
+                        "stream": stream_name,
                         "fallback_codes":
                             (q.get("attrs") or {}).get("fallback_codes"),
                         "spmd_fallback":

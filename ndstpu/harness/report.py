@@ -58,7 +58,8 @@ class BenchReport:
                          "pinned seeds reuse a warmed corpus"),
             }
 
-    def report_on(self, fn: Callable, *args, query_name: str = None):
+    def report_on(self, fn: Callable, *args, query_name: str = None,
+                  span_attrs: dict | None = None):
         redacted = ("TOKEN", "SECRET", "PASSWORD")
         self.summary["env"]["envVars"] = {
             k: v for k, v in os.environ.items()
@@ -67,8 +68,11 @@ class BenchReport:
         self.summary["env"]["engineVersion"] = ndstpu.__version__
         start_time = int(time.time() * 1000)
         counters_before = obs.counters_snapshot()
+        # span_attrs tags the query span for trace/ledger consumers —
+        # the throughput harness stamps the stream id on every query
+        # span so one shared trace stays attributable per stream
         qspan = obs.span(query_name or getattr(fn, "__name__", "query"),
-                         cat="query", collect=True)
+                         cat="query", collect=True, **(span_attrs or {}))
         try:
             with warnings.catch_warnings(record=True) as caught:
                 warnings.simplefilter("always")

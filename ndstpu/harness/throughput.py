@@ -2,12 +2,20 @@
 
 The reference fans out concurrent spark-submit processes with
 `xargs -d, -P<n> -I{}` substituting the stream id into the command
-(/root/reference/nds/nds-throughput:18-23).  Here each stream is one OS
-process running the power CLI with `{}` placeholders substituted the same
-way.  `--concurrent N` bounds how many streams execute on the shared
-device at once (the `spark.rapids.sql.concurrentGpuTasks` analog,
-power_run_gpu.template:21) via a cross-process file-lock semaphore —
-see ndstpu.harness.admission.
+(/root/reference/nds/nds-throughput:18-23).  Two modes:
+
+* ``--mode process`` (default, spec-faithful shape): each stream is one
+  OS process running the power CLI with `{}` placeholders substituted
+  the same way.  `--concurrent N` bounds how many streams execute on
+  the shared device at once (the `spark.rapids.sql.concurrentGpuTasks`
+  analog, power_run_gpu.template:21) via a cross-process file-lock
+  semaphore — see ndstpu.harness.admission.
+* ``--mode inproc`` (fast path): the same N streams run as worker
+  threads over ONE shared session/executor so the warehouse loads once
+  and each distinct query compiles once — see
+  ndstpu.harness.scheduler.  Same `--concurrent` slot semantics
+  (in-process gate), same overlap-report format, same time-log
+  contract.
 
     python -m ndstpu.harness.throughput 1,2,3 --concurrent 2 -- \\
         python -m ndstpu.harness.power ./query_{}.sql ./wh ./time_{}.csv
@@ -24,6 +32,7 @@ import time
 from typing import Dict, List, Optional
 
 from ndstpu import obs
+from ndstpu.harness import progress
 
 
 def concurrency_timeline(records: List[dict]) -> dict:
@@ -60,6 +69,45 @@ def concurrency_timeline(records: List[dict]) -> dict:
     }
 
 
+def write_overlap_report(overlap_report: Optional[str],
+                         records: List[dict],
+                         concurrent: Optional[int],
+                         budget_s: Optional[float],
+                         mode: str = "process",
+                         extra: Optional[dict] = None) -> dict:
+    """Build (and, when a path is given, write) the overlap-evidence
+    document both throughput modes share.  ``stream_max_concurrent`` is
+    always the stream-wall event sweep; in process mode
+    ``max_concurrent`` is the same number (each stream process holds
+    the device for its whole wall), while the inproc scheduler
+    overrides it via ``extra`` with the admission gate's device-level
+    peak — the number the ``concurrent: N`` cap is judged against."""
+    timeline = concurrency_timeline(records)
+    obs.set_gauge("harness.throughput.max_concurrent_streams",
+                  timeline["max_concurrent"])
+    doc = {
+        "format": "ndstpu-throughput-overlap-v1",
+        "mode": mode,
+        "admission_slots": concurrent,
+        "budget_s": budget_s,
+        "streams": sorted(records, key=lambda r: r["start_epoch_s"]),
+        **timeline,
+        "stream_max_concurrent": timeline["max_concurrent"],
+    }
+    if extra:
+        doc.update({k: v for k, v in extra.items() if v is not None})
+    if overlap_report:
+        d = os.path.dirname(overlap_report)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(overlap_report, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"====== Overlap evidence: {overlap_report} "
+              f"(max_concurrent={doc['max_concurrent']}, "
+              f"admission_slots={concurrent}) ======")
+    return doc
+
+
 def run_throughput(stream_ids: List[str], cmd_template: List[str],
                    concurrent: Optional[int] = None,
                    budget_s: Optional[float] = None,
@@ -89,16 +137,24 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
             pending[sid] = subprocess.Popen(cmd, env=env)
         rc = 0
         records: List[dict] = []
+        hb = progress.Heartbeat("throughput", total=len(stream_ids),
+                                budget_s=budget_s)
         last_hb = time.time()
         # poll instead of wait() so each stream's end timestamp is
         # observed when it actually exits (sequential wait() would
         # charge an early finisher the laggards' runtime and inflate
-        # the overlap evidence)
+        # the overlap evidence); the poll interval backs off
+        # exponentially while nothing exits — streams run minutes, so
+        # a fixed short poll is pure busy-wait — and snaps back to
+        # fine-grained on each completion so end timestamps stay sharp
+        poll_s = 0.01
         while pending:
+            completed = False
             for sid, p in list(pending.items()):
                 code = p.poll()
                 if code is None:
                     continue
+                completed = True
                 del pending[sid]
                 end = time.time()
                 wall = end - starts[sid]
@@ -115,49 +171,19 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
                     "wall_s": round(wall, 3),
                     "returncode": code,
                 })
-                done = len(records)
-                line = (f"[heartbeat] throughput stream {sid} done "
-                        f"{done}/{len(stream_ids)} wall={wall:.1f}s "
-                        f"elapsed={end - t0:.1f}s")
-                if budget_s:
-                    line += (f" budget={budget_s:g}s "
-                             f"remaining={budget_s - (end - t0):.1f}s")
-                print(line)
+                hb.beat(len(records), f"stream_{sid} done "
+                        f"wall={wall:.1f}s", end - t0)
                 if code:
                     obs.inc("harness.throughput.streams_failed")
                 rc = rc or code
             if pending:
-                time.sleep(0.05)
+                poll_s = 0.01 if completed else min(poll_s * 2, 0.5)
+                time.sleep(poll_s)
                 if time.time() - last_hb >= 30.0:
                     last_hb = time.time()
-                    el = last_hb - t0
-                    line = (f"[heartbeat] throughput "
-                            f"{len(records)}/{len(stream_ids)} streams "
-                            f"done elapsed={el:.1f}s")
-                    if budget_s:
-                        line += (f" budget={budget_s:g}s "
-                                 f"remaining={budget_s - el:.1f}s")
-                    print(line)
-        timeline = concurrency_timeline(records)
-        obs.set_gauge("harness.throughput.max_concurrent_streams",
-                      timeline["max_concurrent"])
-        if overlap_report:
-            doc = {
-                "format": "ndstpu-throughput-overlap-v1",
-                "admission_slots": concurrent,
-                "budget_s": budget_s,
-                "streams": sorted(records,
-                                  key=lambda r: r["start_epoch_s"]),
-                **timeline,
-            }
-            d = os.path.dirname(overlap_report)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            with open(overlap_report, "w") as f:
-                json.dump(doc, f, indent=2)
-            print(f"====== Overlap evidence: {overlap_report} "
-                  f"(max_concurrent={timeline['max_concurrent']}, "
-                  f"admission_slots={concurrent}) ======")
+                    hb.beat(len(records), "waiting", last_hb - t0)
+        write_overlap_report(overlap_report, records, concurrent,
+                             budget_s, mode="process")
         return rc
     finally:
         if lock_dir is not None:
@@ -198,6 +224,11 @@ def main(argv: List[str]) -> int:
     if err:
         print(err, file=sys.stderr)
         return 2
+    mode, err = take("--mode", str,
+                     lambda v: v in ("process", "inproc"))
+    if err:
+        print(err, file=sys.stderr)
+        return 2
     if budget_s is None and os.environ.get("NDSTPU_PHASE_BUDGET_S"):
         try:
             budget_s = float(os.environ["NDSTPU_PHASE_BUDGET_S"])
@@ -209,10 +240,16 @@ def main(argv: List[str]) -> int:
         ids_arg, cmd = head[:1], head[1:]
     if not ids_arg or not cmd:
         print("usage: throughput <id,id,...> [--concurrent N] "
-              "[--budget_s S] [--overlap_report PATH] -- "
+              "[--budget_s S] [--overlap_report PATH] "
+              "[--mode process|inproc] -- "
               "<command with {} placeholders>", file=sys.stderr)
         return 2
     stream_ids = [s for s in ids_arg[0].split(",") if s]
+    if mode == "inproc":
+        from ndstpu.harness import scheduler
+        return scheduler.run_streams_inproc(
+            stream_ids, cmd, concurrent, budget_s=budget_s,
+            overlap_report=overlap_report).rc
     return run_throughput(stream_ids, cmd, concurrent,
                           budget_s=budget_s,
                           overlap_report=overlap_report)

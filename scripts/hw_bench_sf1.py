@@ -56,11 +56,13 @@ def main() -> int:
     # chaining — DERIVED from the config, not asserted, so an edited
     # yml cannot silently invalidate the recorded claim
     rngseed_pinned = "rngseed" in stream_cfg
-    if stream_cfg.get("rngseed") == "bench":
-        from ndstpu.queries.streamgen import BENCH_RNGSEED
-        rngseed_resolved = BENCH_RNGSEED
-    else:
-        rngseed_resolved = stream_cfg.get("rngseed")
+    # resolved through the orchestrator's own resolver so the "bench"
+    # sentinel -> streamgen.BENCH_RNGSEED mapping (and the unquoted-seed
+    # validation) lives in exactly one place; the load report is only
+    # consulted for unpinned seeds, which never reach this branch
+    from ndstpu.harness.bench import resolve_stream_rngseed
+    rngseed_resolved = resolve_stream_rngseed(
+        stream_cfg, load_report_file="") if rngseed_pinned else None
     # the replay claim below must be derived, not asserted: if the warm
     # artifacts are absent (e.g. after an environment reset) the power
     # phase silently pays full discovery — and records alone are not

@@ -1,0 +1,328 @@
+"""In-process multi-stream throughput scheduler tests.
+
+The tentpole claims (ndstpu/harness/scheduler.py): N streams over ONE
+shared session produce per-query results identical to a serial run;
+each distinct query text plans/compiles ONCE (proven by the obs cache
+counters, not by timing); the admission gate bounds device-level
+concurrency at ``slots`` while stream walls still overlap; and one
+stream's failing query neither poisons the shared caches nor the other
+streams.
+"""
+
+import json
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from ndstpu import obs
+from ndstpu.engine.latch import KeyedLatch
+from ndstpu.engine.sql import normalize_sql_key
+from ndstpu.harness import bench as bench_mod
+from ndstpu.harness.admission import InprocAdmission
+from ndstpu.harness.scheduler import StreamScheduler, run_streams_inproc
+
+
+@pytest.fixture(scope="module")
+def env():
+    return dict(os.environ, PYTHONPATH=os.getcwd())
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory, env):
+    root = tmp_path_factory.mktemp("nds_sched")
+    subprocess.run(["python", "-m", "ndstpu.datagen.driver", "local",
+                    "0.002", "2", str(root / "raw")], check=True, env=env)
+    subprocess.run(["python", "-m", "ndstpu.io.transcode",
+                    "--input_prefix", str(root / "raw"),
+                    "--output_prefix", str(root / "wh"),
+                    "--report_file", str(root / "load.txt"),
+                    "--output_format", "ndslake"],
+                   check=True, env=env, stdout=subprocess.DEVNULL)
+    return root
+
+
+TINY_STREAM = (
+    "-- start query 1 in stream 0 using template query1.tpl\n"
+    "select i_item_sk, i_current_price from item\n"
+    "where i_item_sk < 100 order by i_item_sk\n;\n"
+    "-- end query 1 in stream 0 using template query1.tpl\n"
+    "-- start query 2 in stream 0 using template query2.tpl\n"
+    "select count(*) as cnt from store_sales\n;\n"
+    "-- end query 2 in stream 0 using template query2.tpl\n")
+
+
+# -- unit: the locking/admission/scheduling primitives -----------------------
+
+
+def test_keyed_latch_exclusive_per_key_and_cleanup():
+    latch = KeyedLatch()
+    order = []
+    inside = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with latch.holding("k"):
+            order.append("first-in")
+            inside.set()
+            release.wait(5)
+            order.append("first-out")
+
+    def waiter():
+        inside.wait(5)
+        with latch.holding("k"):
+            order.append("second-in")
+
+    t1 = threading.Thread(target=holder)
+    t2 = threading.Thread(target=waiter)
+    t1.start()
+    t2.start()
+    inside.wait(5)
+    assert len(latch) == 1  # key registered while held/contended
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert order == ["first-in", "first-out", "second-in"]
+    assert len(latch) == 0  # refcount cleanup: no per-key leak
+
+
+def test_keyed_latch_releases_on_exception():
+    latch = KeyedLatch()
+    with pytest.raises(RuntimeError):
+        with latch.holding("k"):
+            raise RuntimeError("boom")
+    # a crashed holder must not deadlock the next arrival
+    with latch.holding("k"):
+        pass
+    assert len(latch) == 0
+
+
+def test_inproc_admission_caps_concurrency():
+    gate = InprocAdmission(2)
+    n_threads = 5
+
+    def work():
+        with gate.slot():
+            time.sleep(0.03)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    tl = gate.device_timeline()
+    assert tl["slots"] == 2
+    assert 1 <= tl["max_concurrent"] <= 2
+    assert tl["gated_queries"] == n_threads
+    assert tl["busy_s_total"] > 0
+    with pytest.raises(ValueError):
+        InprocAdmission(0)
+
+
+def test_stream_scheduler_cold_cheapest_first_and_sharing():
+    texts = {"a": "select 1", "b": "select 2", "c": "select 3"}
+    cold = {"a": 10.0, "b": 2.0, "c": 5.0}
+    sched = StreamScheduler({"1": dict(texts), "2": dict(texts)},
+                            est_cold=lambda n: cold[n],
+                            est_warm=lambda n: 1.0)
+    v1, v2 = sched.view("1"), sched.view("2")
+    assert v1.next(0) == "b"  # cheapest cold prior first
+    # b is in flight on stream 1 -> stream 2 starts a DIFFERENT compile
+    assert v2.next(0) == "c"
+    v1.done("b")
+    # cold-before-warm: a (cold, 10s) outranks the published b (warm)
+    # so compiles keep front-loading
+    assert v2.next(0) == "a"
+    assert v2.next(0) == "b"
+    for n in ("c", "a", "b"):
+        v2.done(n)
+    # everything stream 1 still holds is compiled now: cheapest-warm
+    # order with original-index tiebreak
+    assert v1.next(0) == "a"
+    assert v1.next(0) == "c"
+    assert v1.next(0) is None
+    assert not v1.skipped and not v2.skipped
+
+
+def test_stream_scheduler_failed_query_not_published():
+    sched = StreamScheduler({"1": {"a": "select 1"},
+                             "2": {"a": "select 1"}})
+    v1 = sched.view("1")
+    assert v1.next(0) == "a"
+    v1.done("a", failed=True)
+    key = normalize_sql_key("select 1")
+    assert key not in sched.compiled  # others keep their cold estimate
+    assert key not in sched.inflight
+
+
+def test_stream_scheduler_budget_degrades_explicitly(capsys):
+    sched = StreamScheduler({"1": {"a": "select 1", "b": "select 2"}},
+                            budget_s=10.0,
+                            est_cold=lambda n: {"a": 4.0, "b": 20.0}[n],
+                            est_warm=lambda n: 1.0)
+    v = sched.view("1")
+    assert v.next(0) == "a"
+    v.done("a")
+    # remaining 5s cannot fit b's 20s prior: explicit per-query reason
+    assert v.next(5.0) is None
+    assert "exceeds remaining" in v.skipped["b"]
+    out = capsys.readouterr().out
+    assert "[budget]" in out and "cheapest-first" in out
+
+    sched2 = StreamScheduler({"1": {"a": "select 1"}}, budget_s=5.0)
+    v2 = sched2.view("1")
+    assert v2.next(6.0) is None  # already past the deadline
+    assert "budget exhausted" in v2.skipped["a"]
+
+
+# -- end to end: shared-session streams over a real warehouse ----------------
+
+
+def _inproc_cmd(dataset, tmp_path, stream_file, *extra):
+    return ["python", "-m", "ndstpu.harness.power", str(stream_file),
+            str(dataset / "wh"), str(tmp_path) + "/time_{}.csv",
+            "--input_format", "ndslake", *extra]
+
+
+def test_inproc_parity_compile_once_and_overlap(dataset, tmp_path):
+    """2 streams x same texts on one shared session: results match a
+    serial run bit-for-bit, each distinct text plans once (hit counters
+    >= (N-1) x distinct), and the overlap report carries both the
+    device-gate peak (<= slots) and nonzero stream overlap."""
+    stream_file = tmp_path / "query_0.sql"
+    stream_file.write_text(TINY_STREAM)
+    overlap = tmp_path / "overlap.json"
+    obs.reset()
+    before = obs.counters_snapshot()
+    res = run_streams_inproc(
+        ["1", "2"],
+        _inproc_cmd(dataset, tmp_path, stream_file,
+                    "--output_prefix", str(tmp_path) + "/out_{}"),
+        concurrent=2, overlap_report=str(overlap))
+    assert res.rc == 0 and not res.errors
+    delta = obs.counter_delta(before)
+
+    # compile-once evidence: 2 distinct texts, 4 executions -> exactly
+    # 2 plan misses and >= (streams-1) x distinct = 2 plan hits
+    assert delta.get("engine.cache.plan.miss") == 2
+    assert delta.get("engine.cache.plan.hit", 0) >= 2
+
+    # overlap evidence: device peak bounded by slots, stream walls
+    # genuinely concurrent (two threads started together, >= 2 queries
+    # each), process-compatible format plus the inproc extras
+    ov = json.loads(overlap.read_text())
+    assert ov["format"] == "ndstpu-throughput-overlap-v1"
+    assert ov["mode"] == "inproc"
+    assert ov["max_concurrent"] <= 2
+    assert ov["device_timeline"]["max_concurrent"] <= 2
+    assert ov["stream_max_concurrent"] == 2
+    assert ov["pairwise_overlap_s"]["1&2"] > 0
+    assert res.gate.device_timeline()["gated_queries"] == 4
+
+    # per-stream results: every query ran in both streams (order is
+    # the scheduler's to choose — in-flight texts defer to cold ones)
+    for sid in ("1", "2"):
+        assert set(res.results[sid]["executed"]) == {"query1", "query2"}
+        assert res.results[sid]["failures"] == 0
+
+    # time-log contract: bench's throughput-elapsed math parses both
+    for sid in ("1", "2"):
+        text = (tmp_path / f"time_{sid}.csv").read_text()
+        assert "Power Start Time" in text and "Power End Time" in text
+    assert bench_mod.get_throughput_time(
+        str(tmp_path / "time"), 2, 1) >= 0
+
+    # parity: stream outputs identical to each other AND to a serial
+    # session over a fresh catalog
+    import pyarrow.parquet as pq
+
+    from ndstpu.engine.session import Session
+    from ndstpu.harness.power import gen_sql_from_stream, run_one_query
+    from ndstpu.io import loader
+    serial = Session(loader.load_catalog(str(dataset / "wh")))
+    for name, sql in gen_sql_from_stream(str(stream_file)).items():
+        run_one_query(serial, sql, name,
+                      str(tmp_path / "out_serial"), "parquet")
+    for name in ("query1", "query2"):
+        tables = [pq.read_table(
+            tmp_path / f"out_{tag}" / name / "part-0.parquet")
+            for tag in ("1", "2", "serial")]
+        assert tables[0].equals(tables[1])
+        assert tables[0].equals(tables[2])
+
+    # one trace + one sidecar for the whole phase, streams tagged
+    sidecar = json.loads(
+        (tmp_path / "overlap.json.metrics.json").read_text())
+    assert sidecar["mode"] == "inproc"
+    assert {r["stream"] for r in sidecar["streams"]} == {"1", "2"}
+    tagged = [q for q in obs.tracer().query_summaries()
+              if (q.get("attrs") or {}).get("stream_id")]
+    assert {(q["attrs"]["stream_id"], q["query"]) for q in tagged} == {
+        (sid, q) for sid in ("1", "2") for q in ("query1", "query2")}
+
+
+def test_inproc_shares_compiled_executor_cache(dataset, tmp_path):
+    """On the accel engine the shared executor compiles each distinct
+    text once: exactly ``distinct`` compiled-cache misses and
+    >= (streams-1) x distinct hits across 2 streams."""
+    stream_file = tmp_path / "query_0.sql"
+    stream_file.write_text(TINY_STREAM)
+    obs.reset()
+    before = obs.counters_snapshot()
+    res = run_streams_inproc(
+        ["1", "2"],
+        _inproc_cmd(dataset, tmp_path, stream_file, "--engine", "tpu"),
+        concurrent=2)
+    assert res.rc == 0 and not res.errors
+    for sid in ("1", "2"):
+        assert res.results[sid]["failures"] == 0
+    delta = obs.counter_delta(before)
+    assert delta.get("engine.cache.compiled.miss") == 2
+    assert delta.get("engine.cache.compiled.hit", 0) >= 2
+    assert delta.get("engine.cache.plan.miss") == 2
+    assert delta.get("engine.cache.plan.hit", 0) >= 2
+
+
+def test_inproc_failure_isolated_from_shared_cache(dataset, tmp_path):
+    """A failing query in one stream must not poison the shared plan
+    cache, mark its text compiled, or disturb the other stream."""
+    bad_sql = "select nonexistent_column from item"
+    (tmp_path / "query_A.sql").write_text(
+        "-- start query 1 in stream 0 using template query1.tpl\n"
+        f"{bad_sql}\n;\n"
+        "-- end query 1 in stream 0 using template query1.tpl\n")
+    (tmp_path / "query_B.sql").write_text(
+        "-- start query 1 in stream 0 using template query1.tpl\n"
+        "select count(*) as cnt from item\n;\n"
+        "-- end query 1 in stream 0 using template query1.tpl\n")
+    obs.reset()
+    res = run_streams_inproc(
+        ["A", "B"],
+        _inproc_cmd(dataset, tmp_path, str(tmp_path) + "/query_{}.sql"),
+        concurrent=2)
+    # a Failed query is a recorded benchmark outcome, not a crash
+    assert res.rc == 0 and not res.errors
+    assert res.results["A"]["failures"] == 1
+    assert res.results["B"]["failures"] == 0
+    assert res.results["B"]["executed"] == ["query1"]
+    bad_key = normalize_sql_key(bad_sql)
+    assert bad_key not in res.session._plan_cache  # no poisoning
+    assert bad_key not in res.scheduler.compiled
+    assert not res.scheduler.inflight  # nothing stranded in flight
+
+
+def test_inproc_rejects_divergent_stream_templates(dataset, tmp_path):
+    """Streams resolving to different warehouses cannot share one
+    session — explicit refusal, not a silent wrong answer."""
+    stream_file = tmp_path / "query_0.sql"
+    stream_file.write_text(TINY_STREAM)
+    os.makedirs(tmp_path / "wh_1", exist_ok=True)
+    os.makedirs(tmp_path / "wh_2", exist_ok=True)
+    cmd = ["python", "-m", "ndstpu.harness.power", str(stream_file),
+           str(tmp_path) + "/wh_{}", str(tmp_path) + "/time_{}.csv"]
+    with pytest.raises(ValueError, match="share one input_prefix"):
+        run_streams_inproc(["1", "2"], cmd)
+    with pytest.raises(ValueError, match="ndstpu.harness.power"):
+        run_streams_inproc(["1"], ["python", "-m", "something.else"])
