@@ -3169,6 +3169,8 @@ class CompilingExecutor(JaxExecutor):
         if cp is not None and cp.versions != versions:
             cp = None
         if cp is None:
+            from ndstpu import faults
+            faults.check("compile", key=key)
             obs.inc("engine.cache.compiled.miss")
             return self._discover_query(p, key, versions)
         obs.inc("engine.cache.compiled.hit")

@@ -131,7 +131,8 @@ class Session:
         Planning itself is host-pure (reads catalog/views), so distinct
         texts plan concurrently while the device executes.
         """
-        from ndstpu import obs
+        from ndstpu import faults, obs
+        faults.check("plan", key=key)
         pc = getattr(self, "_plan_cache", None)
         if pc is None:
             with getattr(self, "_cache_lock", _NULL_CM):
@@ -216,6 +217,8 @@ class Session:
 
     def _execute(self, plan: lp.Plan,
                  key: Optional[str] = None) -> columnar.Table:
+        from ndstpu import faults
+        faults.check("execute", key=key)
         # single-chip out-of-core: when chunk_rows is set, the `tpu`
         # backend streams facts through the SAME chunked executor as
         # tpu-spmd, just over a 1-device mesh (SF >> HBM on one chip;

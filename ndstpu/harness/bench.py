@@ -28,7 +28,9 @@ from contextlib import contextmanager
 
 import yaml
 
-from ndstpu import obs
+from ndstpu import faults, obs
+from ndstpu.harness import runstate
+from ndstpu.io import atomic
 
 PY = [sys.executable, "-m"]
 
@@ -182,17 +184,17 @@ def get_perf_metric(scale_factor, num_streams_in_throughput, queries_per_stream,
 
 
 def write_metrics_report(path: str, metrics: dict) -> None:
-    with open(path, "w") as f:
-        for k, v in metrics.items():
-            f.write(f"{k},{v}\n")
+    text = "".join(f"{k},{v}\n" for k, v in metrics.items())
+    atomic.atomic_write_text(path, text)
 
 
 def run(cmd, **kw):
     print("====", " ".join(str(c) for c in cmd))
+    faults.check("phase.subprocess", key=str(cmd[0]) if cmd else None)
     subprocess.run([str(c) for c in cmd], check=True, **kw)
 
 
-def run_full_bench(yaml_params: dict) -> None:
+def run_full_bench(yaml_params: dict, resume: bool = False) -> None:
     d = yaml_params["data_gen"]
     l = yaml_params["load_test"]
     g = yaml_params["generate_query_stream"]
@@ -209,6 +211,28 @@ def run_full_bench(yaml_params: dict) -> None:
     if ledger_path:
         ledger_path = os.path.abspath(ledger_path)
 
+    # crash-safe resume: the RUN_STATE.json journal records each phase
+    # completed under this config fingerprint; --resume auto-skips them
+    # (replacing hand-edited per-phase skip: flags after a crash)
+    state = runstate.RunState.for_bench(yaml_params)
+    if resume:
+        done = state.completed_phases()
+        if done:
+            print(f"[resume] {state.path}: skipping completed phases "
+                  f"{sorted(done)} (fingerprint "
+                  f"{state.fingerprint[:12]})")
+            obs.inc("harness.resume.phases_skipped", len(done))
+    else:
+        state.reset()
+        done = set()
+
+    def phase_done(name: str) -> bool:
+        if name in done:
+            phase_walls[name] = 0.0
+            print(f"[resume] phase {name} already completed — skipping")
+            return True
+        return False
+
     # seed policy: a pinned `rngseed:` breaks spec 4.3.1's unconditional
     # chaining (reference nds_bench.py:413-414 always chains from the
     # load end timestamp).  Publish which policy this run used so
@@ -218,7 +242,7 @@ def run_full_bench(yaml_params: dict) -> None:
         "pinned" if seed_pinned else "chained"
 
     # 1. data generation (+ per-stream refresh sets)
-    if not d.get("skip"):
+    if not d.get("skip") and not phase_done("data_gen"):
         with _phase("data_gen", phase_walls, d.get("budget_s")):
             run(PY + ["ndstpu.datagen.driver", "local", sf,
                       str(d["parallel"]), d["data_path"],
@@ -227,21 +251,28 @@ def run_full_bench(yaml_params: dict) -> None:
                 run(PY + ["ndstpu.datagen.driver", "local", sf,
                           str(d["parallel"]), d["data_path"] + f"_{i}",
                           "--overwrite_output", "--update", str(i)])
+        state.mark("data_gen", artifacts=[d["data_path"]])
 
     # 2. load test
-    if not l.get("skip"):
+    if not l.get("skip") and not phase_done("load_test"):
         with _phase("load_test", phase_walls, l.get("budget_s")):
-            run(PY + ["ndstpu.io.transcode",
-                      "--input_prefix", d["data_path"],
-                      "--output_prefix", l["warehouse_path"],
-                      "--report_file", l["report_file"],
-                      "--output_format",
-                      l.get("warehouse_format", "parquet")])
+            cmd = PY + ["ndstpu.io.transcode",
+                        "--input_prefix", d["data_path"],
+                        "--output_prefix", l["warehouse_path"],
+                        "--report_file", l["report_file"],
+                        "--output_format",
+                        l.get("warehouse_format", "parquet")]
+            if resume:
+                # per-table _SUCCESS markers: finished tables skip
+                cmd += ["--resume"]
+            run(cmd)
+        state.mark("load_test", artifacts=[l["warehouse_path"],
+                                           l["report_file"]])
     load_elapse = get_load_time(l["report_file"])
 
     # 3. query streams (RNGSEED = load end timestamp, spec 4.3.1, or a
     #    pinned `rngseed:` override — see resolve_stream_rngseed)
-    if not g.get("skip"):
+    if not g.get("skip") and not phase_done("generate_query_stream"):
         with _phase("generate_query_stream", phase_walls,
                     g.get("budget_s")):
             rngseed = resolve_stream_rngseed(g, l["report_file"])
@@ -252,15 +283,17 @@ def run_full_bench(yaml_params: dict) -> None:
             if g.get("template_dir"):
                 cmd += ["--template_dir", g["template_dir"]]
             run(cmd)
+        state.mark("generate_query_stream",
+                   artifacts=[g["stream_output_path"]])
     try:
         run_seed = resolve_stream_rngseed(g, l["report_file"])
     except Exception:
         run_seed = "unknown"
 
     # 4. power test
-    if not p.get("skip"):
+    if not p.get("skip") and not phase_done("power_test"):
         with _phase("power_test", phase_walls, p.get("budget_s")):
-            if p.get("json_summary_folder"):
+            if p.get("json_summary_folder") and not resume:
                 import shutil
                 shutil.rmtree(p["json_summary_folder"], ignore_errors=True)
             cmd = PY + ["ndstpu.harness.power",
@@ -290,13 +323,19 @@ def run_full_bench(yaml_params: dict) -> None:
                           f"exist yet — accel power runs will pay full "
                           f"discovery")
                 cmd += ["--compile_records", rec]
+            if resume:
+                # mid-phase kill recovery: the power runner replays its
+                # per-query progress journal and skips finished queries
+                cmd += ["--resume"]
             run(cmd)
+        state.mark("power_test", artifacts=[p["report_file"]])
     power_elapse = float(get_power_time(p["report_file"])) / 1000
 
     # 5./6. throughput + maintenance, twice
     ttt, tdm = {}, {}
     for fs in (1, 2):
-        if not t.get("skip"):
+        if not t.get("skip") and \
+                not phase_done(f"throughput_test_{fs}"):
             with _phase(f"throughput_test_{fs}", phase_walls,
                         t.get("budget_s")):
                 ids = ",".join(str(x) for x in
@@ -332,8 +371,11 @@ def run_full_bench(yaml_params: dict) -> None:
                 if p.get("compile_records"):
                     pcmd += ["--compile_records", p["compile_records"]]
                 run(tcmd + ["--"] + pcmd)
+            state.mark(f"throughput_test_{fs}",
+                       artifacts=[t["report_base"]])
         ttt[fs] = get_throughput_time(t["report_base"], num_streams, fs)
-        if not m.get("skip"):
+        if not m.get("skip") and \
+                not phase_done(f"maintenance_test_{fs}"):
             with _phase(f"maintenance_test_{fs}", phase_walls,
                         m.get("budget_s")):
                 for i in get_stream_range(num_streams, fs):
@@ -341,6 +383,8 @@ def run_full_bench(yaml_params: dict) -> None:
                               l["warehouse_path"],
                               d["data_path"] + f"_{i}",
                               m["report_base"] + f"_{i}.csv"])
+            state.mark(f"maintenance_test_{fs}",
+                       artifacts=[m["report_base"]])
         tdm[fs] = get_maintenance_time(m["report_base"], num_streams, fs)
 
     qps = len(__import__("ndstpu.queries.streamgen",
@@ -407,9 +451,7 @@ def write_hw_metrics(yaml_params: dict, metrics: dict,
     }
     hw_path = mtr.get("hw_metrics") or os.path.join(
         os.path.dirname(mtr["metrics_report"]) or ".", "hw_metrics.json")
-    os.makedirs(os.path.dirname(hw_path) or ".", exist_ok=True)
-    with open(hw_path, "w") as f:
-        json.dump(hw, f, indent=2)
+    atomic.atomic_write_json(hw_path, hw)
     print(f"HW metrics artifact: {hw_path}")
     return hw_path
 
@@ -417,6 +459,15 @@ def write_hw_metrics(yaml_params: dict, metrics: dict,
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="NDS full benchmark")
     parser.add_argument("yaml_config", help="yaml config file (bench.yml)")
-    with open(parser.parse_args().yaml_config) as f:
+    parser.add_argument("--resume", action="store_true",
+                        help="crash-safe resume: replay the "
+                             "RUN_STATE.json journal (next to the "
+                             "metrics report) and skip phases already "
+                             "completed under the same config "
+                             "fingerprint; the power and load phases "
+                             "additionally resume mid-phase via their "
+                             "own journals/markers")
+    cli = parser.parse_args()
+    with open(cli.yaml_config) as f:
         params = yaml.safe_load(f)
-    run_full_bench(params)
+    run_full_bench(params, resume=cli.resume)

@@ -28,7 +28,7 @@ from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
 from ndstpu.engine.session import Session
 from ndstpu.harness.report import BenchReport
-from ndstpu.io import csvio, loader
+from ndstpu.io import atomic, csvio, loader
 
 DM_DIR = Path(__file__).resolve().parent / "data_maintenance"
 
@@ -116,7 +116,7 @@ def run_query(args) -> None:
     # header matches the reference (nds_maintenance.py:261); per-function
     # rows carry the report's millisecond values like the reference does
     header = ["application_id", "query", "time/s"]
-    with open(args.time_log, "w", newline="") as f:
+    with atomic.atomic_writer(args.time_log, "w", newline="") as f:
         w = csv.writer(f)
         w.writerow(header)
         w.writerows(execution_times)

@@ -26,13 +26,13 @@ import uuid
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
-from ndstpu import obs
+from ndstpu import faults, obs
 from ndstpu.check import check_json_summary_folder, check_query_subset_exists
 from ndstpu.engine import columnar
 from ndstpu.engine.session import Session
 from ndstpu.harness import progress
 from ndstpu.harness.report import BenchReport
-from ndstpu.io import loader
+from ndstpu.io import atomic, loader
 from ndstpu.obs import ledger as ledger_mod
 from ndstpu.obs import sentinel
 
@@ -239,12 +239,15 @@ def run_stream(query_dict, *, queue, runner,
                engine: str = "cpu", app_id: Optional[str] = None,
                stream_name: str = "stream",
                engine_conf: Optional[Dict[str, str]] = None,
-               gate=None, pre_query=None,
+               gate=None, pre_query=None, post_query=None,
                json_summary_folder: Optional[str] = None,
                summary_prefix: str = "",
                xla_cache_dir: Optional[str] = None,
                t0: Optional[float] = None,
-               span_attrs: Optional[dict] = None) -> dict:
+               span_attrs: Optional[dict] = None,
+               retry_policy: Optional[faults.RetryPolicy] = None,
+               quarantine: Optional[faults.Quarantine] = None,
+               completed: Optional[set] = None) -> dict:
     """Run one query stream's per-query loop against an already-built
     execution context.  This is the reusable core the power CLI and the
     in-process throughput scheduler share: the CLI wraps it with its own
@@ -262,10 +265,21 @@ def run_stream(query_dict, *, queue, runner,
       (DeviceAdmission or InprocAdmission), or None.
     * ``pre_query``  — optional hook returning a dict merged into the
       query summary (the CLI's zombie-thread bookkeeping).
+    * ``post_query`` — optional ``post_query(name, summary, failed)``
+      hook called after each query completes or fails (the resume
+      journal appends its per-query record here).
+    * ``retry_policy`` / ``quarantine`` — failure handling
+      (ndstpu/faults/retry.py): transient failures retry with bounded
+      deterministic backoff; a key that keeps failing is quarantined
+      and later occurrences skip with an explicit ``partial_reason``.
+    * ``completed``  — query names already finished by a previous run
+      of the same fingerprint (crash-safe resume); skipped up front
+      and reported under ``resumed``.
 
     Returns ``{"app_id", "rows", "executed", "skipped", "failures",
-    "start_epoch_s", "end_epoch_s"}`` where ``rows`` are
-    ``(app_id, query, millis)`` time-log tuples.
+    "start_epoch_s", "end_epoch_s", "taxonomy", "quarantined",
+    "resumed"}`` where ``rows`` are ``(app_id, query, millis)``
+    time-log tuples.
     """
     t0 = time.time() if t0 is None else t0
     app_id = app_id or f"ndstpu-{uuid.uuid4().hex[:12]}"
@@ -274,6 +288,16 @@ def run_stream(query_dict, *, queue, runner,
     rows: List[tuple] = []
     executed: List[str] = []
     failures = 0
+    taxonomy_counts: Dict[str, int] = {}
+    taxonomy_queries: Dict[str, str] = {}
+    resumed: List[str] = []
+    base_runner = runner
+    if retry_policy is not None or quarantine is not None:
+        # run_with_retry classifies + annotates even at max_attempts=1
+        def runner(sql, qname):  # noqa: F811 — deliberate shadowing
+            faults.run_with_retry(lambda: base_runner(sql, qname),
+                                  qname, policy=retry_policy,
+                                  quarantine=quarantine)
     start_epoch = time.time()
     stream_span = obs.span(stream_name, cat="stream", collect=True,
                            engine=engine, n_queries=len(query_dict),
@@ -284,6 +308,26 @@ def run_stream(query_dict, *, queue, runner,
             query_name = queue.next(time.time() - t0)
             if query_name is None:
                 break
+            if completed and query_name in completed:
+                # crash-safe resume: finished by a previous run of the
+                # same fingerprint — skip without touching the engine
+                print(f"====== Skip {query_name} (resume: already "
+                      f"completed) ======")
+                resumed.append(query_name)
+                if mark_done is not None:
+                    mark_done(query_name, failed=False)
+                continue
+            if quarantine is not None and \
+                    quarantine.is_quarantined(query_name):
+                reason = quarantine.reason(query_name)
+                print(f"====== Skip {query_name} ({reason}) ======")
+                queue.skipped[query_name] = reason
+                obs.inc("harness.quarantine.skips")
+                if mark_done is not None:
+                    # failed=True: a quarantined key must never publish
+                    # to the shared compile/plan caches (PR-4 invariant)
+                    mark_done(query_name, failed=True)
+                continue
             q_content = query_dict[query_name]
             if heartbeat is not None:
                 heartbeat.beat(len(executed) + 1, query_name,
@@ -320,6 +364,11 @@ def run_stream(query_dict, *, queue, runner,
                 summary["queryStatus"][-1] == "Failed"
             if failed:
                 failures += 1
+                for tx in summary.get("failureTaxonomy", []):
+                    if tx.get("query") == query_name:
+                        taxonomy_counts[tx["class"]] = \
+                            taxonomy_counts.get(tx["class"], 0) + 1
+                        taxonomy_queries[query_name] = tx["class"]
             if mark_done is not None:
                 mark_done(query_name, failed=failed)
             if xla_cache_dir:
@@ -340,6 +389,8 @@ def run_stream(query_dict, *, queue, runner,
                 q_report.write_summary(query_name,
                                        prefix=summary_prefix)
             executed.append(query_name)
+            if post_query is not None:
+                post_query(query_name, summary, failed)
     finally:
         stream_span.__exit__(None, None, None)
     if queue.skipped:
@@ -356,9 +407,29 @@ def run_stream(query_dict, *, queue, runner,
         "executed": executed,
         "skipped": dict(queue.skipped),
         "failures": failures,
+        "taxonomy": {"counts": taxonomy_counts,
+                     "queries": taxonomy_queries},
+        "quarantined": quarantine.snapshot() if quarantine else {},
+        "resumed": resumed,
         "start_epoch_s": start_epoch,
         "end_epoch_s": time.time(),
     }
+
+
+def power_fingerprint(args) -> str:
+    """Identity of a power run for crash-safe resume: two runs with the
+    same fingerprint execute the same queries against the same data, so
+    a query completed by one needn't re-run in the other."""
+    import hashlib
+    parts = [
+        str(getattr(args, "engine", "")),
+        str(getattr(args, "scale_factor", "")),
+        str(getattr(args, "run_seed", "")),
+        os.path.basename(getattr(args, "query_stream_file", "") or ""),
+        str(getattr(args, "sub_queries", "") or ""),
+        os.path.abspath(getattr(args, "input_prefix", "") or ""),
+    ]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
 
 
 def run_query_stream(args) -> None:
@@ -551,6 +622,43 @@ def run_query_stream(args) -> None:
     hb = progress.Heartbeat("power", total=len(query_dict),
                             budget_s=budget_s)
 
+    # -- failure handling + crash-safe resume -------------------------
+    # transient failures retry (NDSTPU_RETRY_MAX attempts, deterministic
+    # backoff); a per-query progress journal rides next to the time log
+    # so a killed run can --resume past every query it already finished
+    retry_policy = faults.RetryPolicy.from_env()
+    quarantine = faults.Quarantine()
+    progress_log = args.time_log + ".progress.jsonl"
+    run_fp = power_fingerprint(args)
+    completed: set = set()
+    resumed_rows: List[tuple] = []
+    if getattr(args, "resume", False):
+        for rec in atomic.read_jsonl(progress_log):
+            if rec.get("fp") == run_fp and not rec.get("failed") and \
+                    rec.get("query") in query_dict and \
+                    rec["query"] not in completed:
+                completed.add(rec["query"])
+                resumed_rows.append((rec.get("app_id", app_id),
+                                     rec["query"],
+                                     rec.get("millis") or 0))
+        if completed:
+            print(f"====== Resume: skipping {len(completed)} queries "
+                  f"already completed (fingerprint {run_fp[:12]}) "
+                  f"======")
+            obs.inc("harness.resume.queries_skipped", len(completed))
+    elif os.path.exists(progress_log):
+        os.unlink(progress_log)  # fresh run: the old journal is stale
+
+    def post_query(name, summary, failed):
+        try:
+            atomic.append_jsonl(progress_log, {
+                "fp": run_fp, "query": name, "failed": bool(failed),
+                "millis": summary["queryTimes"][0]
+                if summary["queryTimes"] else None,
+                "app_id": app_id, "ts_epoch_s": time.time()})
+        except Exception as e:  # journal must never fail the run
+            print(f"WARNING: progress journal append failed: {e}")
+
     def pre_query(query_name):
         # abandoned-thread gate: give zombies a short grace window to
         # drain before sharing the device with the next query
@@ -574,11 +682,15 @@ def run_query_stream(args) -> None:
                      heartbeat=hb, engine=args.engine, app_id=app_id,
                      stream_name=stream_name, engine_conf=engine_conf,
                      gate=gate, pre_query=pre_query,
+                     post_query=post_query,
                      json_summary_folder=args.json_summary_folder,
                      summary_prefix=summary_prefix,
                      xla_cache_dir=args.xla_cache_dir,
                      t0=total_start,
-                     span_attrs={"stream": stream_name})
+                     span_attrs={"stream": stream_name},
+                     retry_policy=retry_policy, quarantine=quarantine,
+                     completed=completed)
+    execution_times.extend(resumed_rows)
     execution_times.extend(res["rows"])
     executed = res["executed"]
     power_end = int(time.time())
@@ -598,15 +710,14 @@ def run_query_stream(args) -> None:
             print(f"WARNING: compile records not saved: {e}")
 
     header = ["application_id", "query", "time/milliseconds"]
-    with open(args.time_log, "w", encoding="UTF8", newline="") as f:
+    with atomic.atomic_writer(args.time_log, "w",
+                              encoding="UTF8", newline="") as f:
         w = csv.writer(f)
         w.writerow(header)
         w.writerows(execution_times)
     if args.extra_time_log:
-        os.makedirs(os.path.dirname(args.extra_time_log) or ".",
-                    exist_ok=True)
-        with open(args.extra_time_log, "w", encoding="UTF8",
-                  newline="") as f:
+        with atomic.atomic_writer(args.extra_time_log, "w",
+                                  encoding="UTF8", newline="") as f:
             w = csv.writer(f)
             w.writerow(header)
             w.writerows(execution_times)
@@ -645,6 +756,8 @@ def run_query_stream(args) -> None:
                             (q.get("attrs") or {}).get("fallback_codes"),
                         "spmd_fallback":
                             (q.get("attrs") or {}).get("spmd_fallback"),
+                        "retry_attempts":
+                            (q.get("attrs") or {}).get("retry_attempts"),
                     }.items() if v})
                     for q in qsums
                     if not (q.get("attrs") or {}).get("error")]
@@ -662,7 +775,7 @@ def run_query_stream(args) -> None:
         try:
             paths = obs.export_run(trace_dir, base)
             sidecar = args.time_log + ".metrics.json"
-            with open(sidecar, "w") as f:
+            with atomic.atomic_writer(sidecar, "w") as f:
                 json.dump(obs.run_metrics({
                     "app_id": app_id,
                     "engine": args.engine,
@@ -672,6 +785,9 @@ def run_query_stream(args) -> None:
                     "budget_s": budget_s,
                     "partial": bool(queue.skipped),
                     "partial_reasons": queue.skipped,
+                    "faultTaxonomy": res["taxonomy"],
+                    "quarantined": res["quarantined"] or None,
+                    "resumed": res["resumed"] or None,
                     "ledger": ledger_block,
                     "sentinel": sentinel_block,
                 }), f, indent=2)
@@ -741,6 +857,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(the bench driver passes the resolved seed)")
     p.add_argument("--floats", action="store_true",
                    help="double mode (no decimals)")
+    p.add_argument("--resume", action="store_true",
+                   help="crash-safe resume: replay the per-query "
+                        "progress journal (<time_log>.progress.jsonl) "
+                        "and skip queries already completed by a "
+                        "previous run of the same fingerprint (engine, "
+                        "scale factor, seed, stream, subset, "
+                        "warehouse); their time-log rows are carried "
+                        "over")
     p.add_argument("--static_check", action="store_true",
                    help="run the static plan analyzer over the stream "
                         "before executing anything; on accel engines, "

@@ -22,6 +22,8 @@ from typing import Callable
 
 import ndstpu
 from ndstpu import obs
+from ndstpu.faults import taxonomy
+from ndstpu.io import atomic
 
 
 class BenchReport:
@@ -94,6 +96,15 @@ class BenchReport:
             end_time = int(time.time() * 1000)
             self.summary["queryStatus"].append("Failed")
             self.summary["exceptions"].append(str(e))
+            # classified failure contract (docs/ROBUSTNESS.md): every
+            # failure carries its taxonomy class, never a bare string
+            klass = getattr(e, "taxonomy", None) or taxonomy.classify(e)
+            self.summary.setdefault("failureTaxonomy", []).append({
+                "query": query_name,
+                "class": klass,
+                "type": type(e).__name__,
+                "attempts": getattr(e, "attempts", 1),
+            })
         finally:
             self.summary["startTime"] = start_time
             self.summary["queryTimes"].append(end_time - start_time)
@@ -122,6 +133,5 @@ class BenchReport:
         filename = (f"{prefix}-{query_name}-"
                     f"{self.summary['startTime']}.json")
         self.summary["filename"] = filename
-        with open(filename, "w") as f:
-            json.dump(self.summary, f, indent=2)
+        atomic.atomic_write_json(filename, self.summary)
         return filename

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
+from typing import Dict
 
 from ndstpu.io import lake
 
@@ -19,14 +21,25 @@ FACT_TABLES = ["store_sales", "store_returns", "catalog_sales",
 
 
 def rollback(warehouse: str, timestamp: float,
-             tables=None) -> None:
+             tables=None) -> Dict[str, str]:
+    """Roll back each fact table independently; one bad table must not
+    abort the remaining ones.  Returns ``{table: error}`` for the
+    failures — the CLI exits nonzero if any, since a benchmark rerun
+    against a half-rolled-back warehouse measures garbage."""
+    failures: Dict[str, str] = {}
     for table in tables or FACT_TABLES:
         root = os.path.join(warehouse, table)
         if not lake.is_lake(root):
             print(f"skip {table}: not an ACID (ndslake/ndsdelta) table")
             continue
-        v = lake.rollback_to_timestamp(root, timestamp)
+        try:
+            v = lake.rollback_to_timestamp(root, timestamp)
+        except Exception as e:  # noqa: BLE001 — keep rolling the rest
+            failures[table] = f"{type(e).__name__}: {e}"
+            print(f"ERROR: rollback of {table} failed: {failures[table]}")
+            continue
         print(f"rolled back {table} to snapshot v{v}")
+    return failures
 
 
 if __name__ == "__main__":
@@ -36,5 +49,9 @@ if __name__ == "__main__":
                    help="unix timestamp to roll back to")
     p.add_argument("--tables", help="comma-separated subset")
     a = p.parse_args()
-    rollback(a.warehouse_path, a.timestamp,
-             a.tables.split(",") if a.tables else None)
+    failed = rollback(a.warehouse_path, a.timestamp,
+                      a.tables.split(",") if a.tables else None)
+    if failed:
+        print(f"ERROR: {len(failed)} table rollback(s) failed: "
+              f"{', '.join(sorted(failed))}")
+        sys.exit(1)

@@ -1,0 +1,79 @@
+"""Crash-safe bench resume: the append-only ``RUN_STATE.json`` journal.
+
+The bench driver appends one record per completed phase — phase name,
+config fingerprint, artifact paths — with per-line fsync
+(ndstpu/io/atomic.py), so a ``kill -9`` between phases loses at most
+the in-flight phase.  ``--resume`` replays the journal and auto-skips
+every phase already completed under the SAME fingerprint, replacing the
+reference harness's hand-edited per-phase ``skip:`` flags
+(nds_bench.py:368-399).
+
+The fingerprint is a sha256 over the canonicalized phase configs
+(everything that changes what a phase computes: paths, scale factor,
+seeds, engine).  Editing the config between runs changes the
+fingerprint and invalidates all prior journal entries — a resume never
+splices phases from two different benchmark definitions together.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import List, Optional, Set
+
+from ndstpu.io import atomic
+
+DEFAULT_BASENAME = "RUN_STATE.json"
+
+
+def config_fingerprint(yaml_params: dict) -> str:
+    """Stable identity of a bench config.  ``observability`` and
+    per-phase ``budget_s`` knobs are excluded: changing where traces go
+    or how long a phase may take does not change what it computes."""
+    phases = {}
+    for name, cfg in sorted(yaml_params.items()):
+        if name == "observability" or not isinstance(cfg, dict):
+            continue
+        phases[name] = {k: v for k, v in sorted(cfg.items())
+                        if k != "budget_s"}
+    blob = json.dumps(phases, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+class RunState:
+    """One bench run's phase-completion journal."""
+
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+
+    @classmethod
+    def for_bench(cls, yaml_params: dict) -> "RunState":
+        mtr = yaml_params.get("metrics") or {}
+        root = os.path.dirname(mtr.get("metrics_report") or "") or "."
+        return cls(os.path.join(root, DEFAULT_BASENAME),
+                   config_fingerprint(yaml_params))
+
+    def records(self) -> List[dict]:
+        return atomic.read_jsonl(self.path)
+
+    def completed_phases(self) -> Set[str]:
+        """Phases already completed under THIS config fingerprint."""
+        return {r["phase"] for r in self.records()
+                if r.get("fp") == self.fingerprint and r.get("phase")}
+
+    def mark(self, phase: str,
+             artifacts: Optional[List[str]] = None) -> None:
+        atomic.append_jsonl(self.path, {
+            "phase": phase,
+            "fp": self.fingerprint,
+            "ts_epoch_s": round(time.time(), 3),
+            "artifacts": [str(a) for a in artifacts or []],
+        })
+
+    def reset(self) -> None:
+        """Fresh (non-resume) run: prior journal entries are stale."""
+        if os.path.exists(self.path):
+            os.unlink(self.path)

@@ -44,11 +44,11 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional
 
-from ndstpu import obs
+from ndstpu import faults, obs
 from ndstpu.check import check_json_summary_folder
 from ndstpu.harness import admission as adm
 from ndstpu.harness import power, progress
-from ndstpu.io import loader
+from ndstpu.io import atomic, loader
 from ndstpu.obs import ledger as ledger_mod
 from ndstpu.obs import sentinel
 
@@ -377,6 +377,12 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
     records: List[dict] = []
     rec_lock = threading.Lock()
 
+    # shared across all stream threads: a query key poisoned in one
+    # stream is quarantined for every other stream too (they run the
+    # same permuted query set against one shared session)
+    retry_policy = faults.RetryPolicy.from_env()
+    quarantine = faults.Quarantine()
+
     def worker(sid: str, ns, qd) -> None:
         stream_name = os.path.splitext(
             os.path.basename(ns.query_stream_file))[0]
@@ -398,6 +404,7 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
         start = time.time()
         code = 0
         try:
+            faults.check("stream.worker", key=sid)
             res = power.run_stream(
                 qd, queue=sched.view(sid), runner=runner, heartbeat=hb,
                 engine=engine, stream_name=stream_name,
@@ -406,7 +413,8 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
                 summary_prefix=summary_prefix,
                 xla_cache_dir=ns.xla_cache_dir, t0=t0,
                 span_attrs={"stream": stream_name, "stream_id": sid,
-                            "mode": "inproc"})
+                            "mode": "inproc"},
+                retry_policy=retry_policy, quarantine=quarantine)
             results[sid] = res
             _write_stream_time_log(ns, res, load_ms, t0)
         except Exception as e:  # noqa: BLE001 — one stream's crash
@@ -491,11 +499,25 @@ def _write_stream_time_log(ns, res: dict, load_ms: int,
     for path in (ns.time_log, ns.extra_time_log):
         if not path:
             continue
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        with open(path, "w", encoding="UTF8", newline="") as f:
+        with atomic.atomic_writer(path, "w", encoding="UTF8",
+                                  newline="") as f:
             w = csv.writer(f)
             w.writerow(header)
             w.writerows(rows)
+
+
+def _merge_taxonomy(results: Dict[str, dict]) -> dict:
+    """Phase-level failure taxonomy: per-class counts summed across
+    streams plus the per-(stream, query) class map."""
+    counts: Dict[str, int] = {}
+    queries: Dict[str, str] = {}
+    for sid, res in results.items():
+        tx = res.get("taxonomy") or {}
+        for klass, n in (tx.get("counts") or {}).items():
+            counts[klass] = counts.get(klass, 0) + n
+        for qname, klass in (tx.get("queries") or {}).items():
+            queries[f"{sid}:{qname}"] = klass
+    return {"counts": counts, "queries": queries}
 
 
 def _export_inproc_run(streams, results, errors, records, overlap_doc,
@@ -543,6 +565,8 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
                         (q.get("attrs") or {}).get("fallback_codes"),
                     "spmd_fallback":
                         (q.get("attrs") or {}).get("spmd_fallback"),
+                    "retry_attempts":
+                        (q.get("attrs") or {}).get("retry_attempts"),
                 }.items() if v})
                 for q in qsums
                 if not (q.get("attrs") or {}).get("error")]
@@ -556,7 +580,7 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
     try:
         paths = obs.export_run(trace_dir, base)
         sidecar = os.path.join(trace_dir, base + ".metrics.json")
-        with open(sidecar, "w") as f:
+        with atomic.atomic_writer(sidecar, "w") as f:
             json.dump(obs.run_metrics({
                 "mode": "inproc",
                 "engine": engine,
@@ -570,6 +594,10 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
                 "partial_reasons": {sid: res["skipped"]
                                     for sid, res in results.items()
                                     if res["skipped"]},
+                "faultTaxonomy": _merge_taxonomy(results),
+                "quarantined": next(
+                    (res["quarantined"] for res in results.values()
+                     if res.get("quarantined")), None),
                 "overlap": {k: overlap_doc[k] for k in
                             ("max_concurrent", "stream_max_concurrent",
                              "admission_slots",

@@ -32,7 +32,9 @@ import time
 from typing import Dict, List, Optional
 
 from ndstpu import obs
+from ndstpu.faults import taxonomy
 from ndstpu.harness import progress
+from ndstpu.io import atomic
 
 
 def concurrency_timeline(records: List[dict]) -> dict:
@@ -97,11 +99,7 @@ def write_overlap_report(overlap_report: Optional[str],
     if extra:
         doc.update({k: v for k, v in extra.items() if v is not None})
     if overlap_report:
-        d = os.path.dirname(overlap_report)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(overlap_report, "w") as f:
-            json.dump(doc, f, indent=2)
+        atomic.atomic_write_json(overlap_report, doc)
         print(f"====== Overlap evidence: {overlap_report} "
               f"(max_concurrent={doc['max_concurrent']}, "
               f"admission_slots={concurrent}) ======")
@@ -137,6 +135,11 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
             pending[sid] = subprocess.Popen(cmd, env=env)
         rc = 0
         records: List[dict] = []
+        # a stream subprocess that dies nonzero is restarted ONCE
+        # (taxonomy: transient — a fresh process may succeed) before
+        # the stream counts as failed; the overlap report records both
+        # the restart and the first attempt's envelope
+        restarted: Dict[str, dict] = {}
         hb = progress.Heartbeat("throughput", total=len(stream_ids),
                                 budget_s=budget_s)
         last_hb = time.time()
@@ -158,19 +161,41 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
                 del pending[sid]
                 end = time.time()
                 wall = end - starts[sid]
+                if code and sid not in restarted:
+                    restarted[sid] = {
+                        "returncode": code,
+                        "start_epoch_s": round(starts[sid], 3),
+                        "end_epoch_s": round(end, 3),
+                        "wall_s": round(wall, 3),
+                    }
+                    cmd = [arg.replace("{}", sid)
+                           for arg in cmd_template]
+                    print(f"WARNING: stream {sid} exited {code} — "
+                          f"restarting once (taxonomy: "
+                          f"{taxonomy.TRANSIENT})")
+                    obs.inc("harness.retry.stream_restarts")
+                    starts[sid] = time.time()
+                    pending[sid] = subprocess.Popen(cmd, env=env)
+                    continue
                 # stream lifetimes overlap, so a context-manager span
                 # cannot express them — record each with explicit
                 # timestamps (the per-query detail lives in each
                 # stream process's own trace)
                 obs.record(f"stream_{sid}", "stream", starts[sid],
                            wall, returncode=code)
-                records.append({
+                rec = {
                     "stream": sid,
                     "start_epoch_s": round(starts[sid], 3),
                     "end_epoch_s": round(end, 3),
                     "wall_s": round(wall, 3),
                     "returncode": code,
-                })
+                }
+                if sid in restarted:
+                    rec["restarts"] = 1
+                    rec["first_attempt"] = restarted[sid]
+                    rec["taxonomy"] = taxonomy.TRANSIENT if code == 0 \
+                        else taxonomy.PERMANENT
+                records.append(rec)
                 hb.beat(len(records), f"stream_{sid} done "
                         f"wall={wall:.1f}s", end - t0)
                 if code:

@@ -32,6 +32,7 @@ from typing import List, Optional
 import pyarrow.parquet as pq
 
 from ndstpu.harness.power import gen_sql_from_stream
+from ndstpu.io import atomic
 
 SKIP_QUERIES = {"query65"}
 SKIP_FLOAT_QUERIES = {"query67"}
@@ -256,8 +257,7 @@ def update_summary(folder: str, query_name: str, status: str) -> None:
         if summary.get("query") != query_name:
             continue
         summary["queryValidationStatus"] = [status]
-        with open(path, "w") as f:
-            json.dump(summary, f, indent=2)
+        atomic.atomic_write_json(path, summary)
 
 
 def build_parser() -> argparse.ArgumentParser:

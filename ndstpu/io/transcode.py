@@ -27,8 +27,9 @@ import pyarrow as pa
 import pyarrow.compute as pc
 import pyarrow.parquet as pq
 
+from ndstpu import faults
 from ndstpu import schema as nds_schema
-from ndstpu.io import csvio
+from ndstpu.io import atomic, csvio
 
 FACT_PARTITION = nds_schema.TABLE_PARTITIONING
 
@@ -83,12 +84,34 @@ def _write_single(at: pa.Table, out_dir: str, table: str, fmt: str,
         raise ValueError(f"unsupported format {fmt}")
 
 
+def _success_marker(args, table: str) -> str:
+    return os.path.join(args.output_prefix, table, "_SUCCESS")
+
+
 def transcode_table(args, table: str, tschema) -> float:
     """Convert one table; returns elapsed seconds (cf. reference
-    nds_transcode.py:179-194 timeit loop)."""
+    nds_transcode.py:179-194 timeit loop).
+
+    Crash safety: a ``_SUCCESS`` marker is written inside the table dir
+    only after the full write completes (loaders glob by extension, so
+    the marker is invisible to them).  ``--resume`` skips marked tables;
+    an UNMARKED existing dir on resume is a torn write from a killed
+    run and is rebuilt from scratch."""
     start = time.time()
-    at = csvio.read_table_dir(args.input_prefix, table, tschema)
     out_root = os.path.join(args.output_prefix, table)
+    marker = _success_marker(args, table)
+    resume = getattr(args, "resume", False)
+    if resume and os.path.exists(marker):
+        print(f"[resume] {table}: _SUCCESS marker present — skipping")
+        return 0.0
+    faults.check("io.write", key=table)
+    at = csvio.read_table_dir(args.input_prefix, table, tschema)
+    if resume and os.path.exists(out_root) and \
+            not os.path.exists(marker):
+        # torn write from the killed run: rebuild the whole table
+        print(f"[resume] {table}: incomplete output (no _SUCCESS) — "
+              f"rebuilding")
+        shutil.rmtree(out_root)
     if os.path.exists(out_root):
         if args.output_mode == "overwrite":
             shutil.rmtree(out_root)
@@ -116,6 +139,7 @@ def transcode_table(args, table: str, tschema) -> float:
     else:
         _write_single(at, out_root, table, args.output_format,
                       args.compression)
+    atomic.atomic_write_text(marker, "")
     return time.time() - start
 
 
@@ -158,8 +182,7 @@ def transcode(args) -> None:
     text = "\n".join(report) + "\n"
     print(text)
     if args.report_file:
-        with open(args.report_file, "w") as f:
-            f.write(text)
+        atomic.atomic_write_text(args.report_file, text)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -182,6 +205,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use double instead of decimal for money columns")
     p.add_argument("--update", action="store_true",
                    help="transcode refresh (maintenance staging) data")
+    p.add_argument("--resume", action="store_true",
+                   help="crash-safe resume: skip tables whose _SUCCESS "
+                        "marker exists; rebuild tables whose output dir "
+                        "exists without one (torn write from a killed "
+                        "run)")
     return p
 
 

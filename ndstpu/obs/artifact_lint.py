@@ -10,7 +10,9 @@ suite via tests/test_doc_lint.py):
    from the tree.  A citation whose line carries an explicit
    not-here-yet marker (``pending``, ``uncommitted``,
    ``not committed``) is exempt — docs may *promise* an artifact, they
-   may not *cite* a ghost.
+   may not *cite* a ghost.  ``RUN_STATE.json`` citations are
+   recognized but exempt from the existence check: it is a per-run
+   resume journal (docs/ROBUSTNESS.md), never a committed file.
 
 2. **Config-mismatch lint** — a ``docs/*.json`` artifact may record
    the engine defaults it was measured under in a top-level
@@ -35,9 +37,15 @@ from typing import Dict, Iterable, List, Optional, Tuple
 CITED_RE = re.compile(
     r"\bdocs/[A-Za-z0-9_.\-/]*\.(?:json|csv)\b"
     r"|\bBENCH_[A-Za-z0-9_.\-]*\.json\b"
-    r"|\bPLAN_LINT\.(?:json|md)\b")
+    r"|\bPLAN_LINT\.(?:json|md)\b"
+    r"|\bRUN_STATE\.json\b")
 
 EXEMPT_MARKERS = ("pending", "uncommitted", "not committed")
+
+# recognized per-run journals: docs cite these by name (they define the
+# resume contract, docs/ROBUSTNESS.md) but every run writes its own
+# next to its artifacts — there is never a committed copy to point at
+RUNTIME_ARTIFACTS = ("RUN_STATE.json",)
 
 _GROUPBY_DEFAULT_RE = re.compile(
     r'^GROUPBY_DEFAULT\s*=\s*["\'](\w+)["\']', re.MULTILINE)
@@ -55,6 +63,8 @@ def lint_text(text: str, root: str, doc: str = "<doc>") -> List[str]:
     for lineno, path, line in cited_artifacts(text):
         low = line.lower()
         if any(mk in low for mk in EXEMPT_MARKERS):
+            continue
+        if os.path.basename(path) in RUNTIME_ARTIFACTS:
             continue
         if not os.path.exists(os.path.join(root, path)):
             findings.append(

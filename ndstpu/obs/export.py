@@ -24,11 +24,14 @@ from ndstpu.obs.trace import Tracer
 
 
 def export_jsonl(tracer: Tracer, path: str) -> str:
+    # imported lazily: obs.__init__ -> export -> io.atomic -> faults ->
+    # obs would be a bootstrap cycle at module-import time
+    from ndstpu.io import atomic
     with tracer._lock:
         events = [dict(e) for e in tracer.events]
         counters = dict(tracer.counters)
         gauges = dict(tracer.gauges)
-    with open(path, "w") as f:
+    with atomic.atomic_writer(path, "w") as f:
         f.write(json.dumps({"type": "meta", "format": "ndstpu-trace-v1",
                             "pid": tracer.pid,
                             "t0_epoch_s": tracer.t0_epoch}) + "\n")
@@ -61,8 +64,8 @@ def export_chrome(tracer: Tracer, path: str) -> str:
     order = {id(e): i for i, e in enumerate(out)}
     out.sort(key=lambda e: (e["ts"], order[id(e)]))
     doc = {"traceEvents": out, "displayTimeUnit": "ms"}
-    with open(path, "w") as f:
-        json.dump(doc, f)
+    from ndstpu.io import atomic
+    atomic.atomic_write_json(path, doc, indent=None)
     return path
 
 

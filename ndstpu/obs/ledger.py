@@ -145,9 +145,14 @@ class Ledger:
             d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)
+            # durable append (flush+fsync): a kill right after a query
+            # completes must not lose its ledger entry, or resume would
+            # re-run it
             with open(self.path, "a") as f:
                 for e in added:
                     f.write(json.dumps(e, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
         return len(added)
 
     def record_query(self, query: str, wall_s: float, compile_s: float,

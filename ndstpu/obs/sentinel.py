@@ -14,7 +14,11 @@ best-known-warm ledger prior (ndstpu/obs/ledger.py):
   tolerance and the absolute floor (both guards: a 0.1 s query
   jittering to 0.14 s is noise, not a regression).
 * ``flat`` — within tolerance.
-* ``failed`` — the query errored; excluded from baselines.
+* ``failed`` — the query errored; excluded from baselines.  When the
+  failure carries a taxonomy class (ndstpu/faults/taxonomy.py, stamped
+  on the span by the retry layer as ``error_taxonomy``), the verdict is
+  ``failed-transient`` or ``failed-permanent``; a failure that never
+  went through the retry layer keeps the bare ``failed``.
 
 Only ``regressed`` verdicts are exit-code-worthy: the CLI wrapper
 (scripts/regression_check.py) exits nonzero on genuine warm-path
@@ -32,7 +36,7 @@ REL_TOL = 0.25      # regressed/improved only beyond +-25% ...
 ABS_FLOOR_S = 0.25  # ... AND more than 0.25s absolute movement
 
 VERDICTS = ("improved", "flat", "regressed", "cold-compile", "new",
-            "failed")
+            "failed", "failed-transient", "failed-permanent")
 
 
 def classify_query(query: str, wall_s: float, compile_s: float,
@@ -97,11 +101,18 @@ def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
     for q in queries:
         name = q["query"]
         if (q.get("attrs") or {}).get("error"):
-            verdicts.append({
+            attrs = q["attrs"]
+            verdict = "failed"
+            if attrs.get("error_taxonomy") in ("transient", "permanent"):
+                verdict = f"failed-{attrs['error_taxonomy']}"
+            v = {
                 "query": name, "wall_s": round(q.get("wall_s", 0.0), 6),
-                "verdict": "failed",
-                "reason": f"query errored: {q['attrs']['error']}",
-            })
+                "verdict": verdict,
+                "reason": f"query errored: {attrs['error']}",
+            }
+            if attrs.get("error_attempts"):
+                v["attempts"] = attrs["error_attempts"]
+            verdicts.append(v)
             continue
         base = led.best_warm(name, engine=engine,
                              scale_factor=scale_factor)
@@ -129,7 +140,8 @@ def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
 def markdown_table(result: dict) -> str:
     """REGRESSIONS.md body: one row per query, regressions first."""
     order = {"regressed": 0, "improved": 1, "new": 2, "flat": 3,
-             "cold-compile": 4, "failed": 5}
+             "cold-compile": 4, "failed": 5, "failed-transient": 6,
+             "failed-permanent": 7}
     rows = sorted(result["verdicts"],
                   key=lambda v: (order.get(v["verdict"], 9), v["query"]))
     lines = [
@@ -155,20 +167,12 @@ def markdown_table(result: dict) -> str:
 
 def write_reports(result: dict, json_path: Optional[str] = None,
                   md_path: Optional[str] = None) -> dict:
-    import json as _json
-    import os as _os
+    from ndstpu.io import atomic
     paths = {}
-    for p in (json_path, md_path):
-        if p:
-            d = _os.path.dirname(p)
-            if d:
-                _os.makedirs(d, exist_ok=True)
     if json_path:
-        with open(json_path, "w") as f:
-            _json.dump(result, f, indent=2)
+        atomic.atomic_write_json(json_path, result)
         paths["json"] = json_path
     if md_path:
-        with open(md_path, "w") as f:
-            f.write(markdown_table(result))
+        atomic.atomic_write_text(md_path, markdown_table(result))
         paths["md"] = md_path
     return paths

@@ -33,6 +33,8 @@ def _note_collective(kind: str, bytes_est: int) -> None:
     they measure how much collective traffic a query's program commits
     to, from static shapes.  ``exchange.shuffle_bytes`` is the
     all-devices total for one execution of the traced op."""
+    from ndstpu import faults
+    faults.check("exchange.collective", key=kind)
     obs.inc(f"exchange.{kind}.calls")
     obs.inc("exchange.shuffle_bytes", int(bytes_est))
 

@@ -1,0 +1,315 @@
+"""Chaos smoke: injected faults + SIGKILL + resume on the full bench.
+
+CI gate for the robustness layer (docs/ROBUSTNESS.md).  One tiny
+corpus, five bench runs:
+
+A. **Baseline** — the 5-phase bench fault-free; committed query
+   outputs + composite metric are the ground truth.
+B. **Fault crash** — same config in a fresh root with a guaranteed
+   ``io.write`` transient fault.  That layer has no retry wrapper by
+   design (the journal/markers make re-running cheaper than retrying a
+   torn write), so the bench must die nonzero after injecting.
+C. **Kill mid-power** — ``--resume`` with ``plan`` transient faults
+   (absorbed by the retry layer) and ``execute`` hang faults (slow the
+   first two queries so the kill window is deterministic); SIGKILL the
+   whole process group as soon as the power progress journal records
+   its first completed query.
+D. **Kill mid-throughput** — ``--resume`` again with the same faults:
+   power must skip the journaled queries, retry-recover the injected
+   faults on the rest, and append retry-annotated ledger entries; the
+   group is SIGKILLed right after ``power_test`` lands in
+   ``RUN_STATE.json``.
+E. **Clean resume** — ``--resume`` with faults off must skip every
+   journaled phase, finish throughput/maintenance, and produce query
+   results identical to the baseline (parquet-level equality) plus a
+   positive composite metric.
+
+Faults are injected at 3 sites (``io.write``, ``plan``, ``execute``)
+across 2 kinds (transient, hang); a standalone power run then injects
+an ``execute`` *permanent* fault and asserts it surfaces classified —
+``faultTaxonomy.counts.permanent`` in the sidecar and a
+``failed-permanent`` sentinel verdict — never as a silent skip.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+PHASES = {"data_gen", "load_test", "generate_query_stream",
+          "power_test", "throughput_test_1", "maintenance_test_1",
+          "throughput_test_2", "maintenance_test_2"}
+
+TEMPLATES = ["query3.tpl", "query42.tpl", "query96.tpl"]
+
+# B: the io.write probe fires once (driver journal append — the layer
+#    with markers instead of retries) and crashes the run.
+# C/D: plan transients are retry-absorbed; the execute hangs make the
+#    first two queries take ~6s each so the SIGKILL deterministically
+#    lands with queries still outstanding.
+CRASH_FAULTS = "io.write:transient:1.0:seed3:times=1"
+CHAOS_FAULTS = ("plan:transient:1.0:seed5:times=1,"
+                "execute:hang:1.0:seedH:times=2:hang=6")
+
+
+def make_cfg(root: pathlib.Path, tpl_dir: pathlib.Path) -> pathlib.Path:
+    import yaml
+    cfg = {
+        "data_gen": {"scale_factor": 0.002, "parallel": 2,
+                     "data_path": str(root / "raw"), "skip": False},
+        "load_test": {"warehouse_path": str(root / "wh"),
+                      "warehouse_format": "ndslake",
+                      "report_file": str(root / "load.txt"),
+                      "skip": False},
+        "generate_query_stream": {
+            # pinned: spec 4.3.1 chains the rngseed from the load end
+            # TIMESTAMP, which would give baseline and chaos runs
+            # different query parameters — results must be comparable
+            "num_streams": 3, "rngseed": "07291122510",
+            "template_dir": str(tpl_dir),
+            "stream_output_path": str(root / "streams"), "skip": False},
+        "power_test": {"engine": "cpu",
+                       "report_file": str(root / "power.csv"),
+                       "output_prefix": str(root / "out"),
+                       "skip": False},
+        "throughput_test": {"report_base": str(root / "tt"),
+                            "skip": False},
+        "maintenance_test": {"report_base": str(root / "dm"),
+                             "skip": False},
+        "metrics": {"metrics_report": str(root / "metrics.csv")},
+        "observability": {"ledger": str(root / "ledger.jsonl")},
+    }
+    path = root / "bench.yml"
+    path.write_text(yaml.safe_dump(cfg))
+    return path
+
+
+def base_env(**extra) -> dict:
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("NDSTPU_FAULTS", None)
+    env.update({k: v for k, v in extra.items() if v is not None})
+    return env
+
+
+def bench_cmd(cfg: pathlib.Path, resume: bool = False) -> list:
+    cmd = [sys.executable, "-m", "ndstpu.harness.bench", str(cfg)]
+    if resume:
+        cmd.append("--resume")
+    return cmd
+
+
+def run_logged(cmd, env, log: pathlib.Path, check_rc=None) -> int:
+    print("+", " ".join(map(str, cmd)), flush=True)
+    with open(log, "w") as f:
+        rc = subprocess.run([str(c) for c in cmd], env=env, stdout=f,
+                            stderr=subprocess.STDOUT,
+                            timeout=1200).returncode
+    print(f"  -> rc={rc} (log: {log})", flush=True)
+    if check_rc is not None:
+        assert rc == check_rc, \
+            f"expected rc={check_rc}, got {rc}:\n{log.read_text()[-4000:]}"
+    return rc
+
+
+def run_until_killed(cmd, env, log: pathlib.Path, trigger,
+                     what: str, timeout_s: float = 900.0) -> None:
+    """Start the bench in its own process group, SIGKILL the whole
+    group the moment ``trigger()`` is true.  The group kill takes the
+    in-flight phase subprocess down with the driver — the same shape as
+    an OOM-killer or operator ``kill -9`` on the session."""
+    print("+", " ".join(map(str, cmd)), f"   [kill on: {what}]",
+          flush=True)
+    with open(log, "w") as f:
+        p = subprocess.Popen([str(c) for c in cmd], env=env, stdout=f,
+                             stderr=subprocess.STDOUT,
+                             start_new_session=True)
+        t0 = time.time()
+        try:
+            while not trigger():
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"bench exited rc={p.returncode} before "
+                        f"'{what}' ever happened:\n"
+                        f"{log.read_text()[-4000:]}")
+                if time.time() - t0 > timeout_s:
+                    raise AssertionError(f"timed out waiting for {what}")
+                time.sleep(0.05)
+        finally:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        p.wait()
+    print(f"  -> SIGKILLed after {time.time() - t0:.1f}s on: {what}",
+          flush=True)
+
+
+def read_jsonl(path: pathlib.Path) -> list:
+    recs = []
+    if not path.exists():
+        return recs
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            pass  # torn tail from a kill — exactly what resume tolerates
+    return recs
+
+
+def completed_queries(progress: pathlib.Path) -> set:
+    return {r["query"] for r in read_jsonl(progress)
+            if r.get("query") and not r.get("failed")}
+
+
+def main() -> int:
+    work = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_chaos"))
+    tpl_dir = work / "tpl"
+    tpl_dir.mkdir()
+    import shutil
+    from ndstpu.queries import streamgen
+    for t in TEMPLATES:
+        shutil.copy(streamgen.TEMPLATE_DIR / t, tpl_dir / t)
+
+    # ---- A. fault-free baseline -------------------------------------
+    root_a = work / "baseline"
+    root_a.mkdir()
+    cfg_a = make_cfg(root_a, tpl_dir)
+    run_logged(bench_cmd(cfg_a), base_env(), work / "a.log", check_rc=0)
+    base_done = completed_queries(
+        pathlib.Path(str(root_a / "power.csv") + ".progress.jsonl"))
+    assert base_done, "baseline recorded no completed queries"
+    base_metrics = dict(
+        line.split(",", 1) for line in
+        (root_a / "metrics.csv").read_text().splitlines())
+    assert int(base_metrics["metric"]) > 0
+
+    # ---- B. injected io.write fault crashes the run -----------------
+    root_b = work / "chaos"
+    root_b.mkdir()
+    cfg_b = make_cfg(root_b, tpl_dir)
+    rc = run_logged(bench_cmd(cfg_b),
+                    base_env(NDSTPU_FAULTS=CRASH_FAULTS),
+                    work / "b.log")
+    assert rc != 0, "io.write fault did not fail the bench"
+    assert "[faults] injected" in (work / "b.log").read_text(), \
+        "no [faults] injection line in the crashed run's log"
+
+    run_state = root_b / "RUN_STATE.json"
+    progress = pathlib.Path(str(root_b / "power.csv") +
+                            ".progress.jsonl")
+
+    # ---- C. resume, SIGKILL mid-power -------------------------------
+    run_until_killed(
+        bench_cmd(cfg_b, resume=True),
+        base_env(NDSTPU_FAULTS=CHAOS_FAULTS),
+        work / "c.log",
+        trigger=lambda: bool(completed_queries(progress)),
+        what="first completed query in the power progress journal")
+    killed_done = completed_queries(progress)
+    assert killed_done and killed_done < base_done, \
+        (f"kill window missed: journal has {sorted(killed_done)} of "
+         f"{sorted(base_done)} — power finished before the SIGKILL")
+    phases_c = {r.get("phase") for r in read_jsonl(run_state)}
+    assert "load_test" in phases_c and "power_test" not in phases_c, \
+        f"unexpected journaled phases after mid-power kill: {phases_c}"
+
+    # ---- D. resume (skip journaled queries), SIGKILL mid-throughput -
+    run_until_killed(
+        bench_cmd(cfg_b, resume=True),
+        base_env(NDSTPU_FAULTS=CHAOS_FAULTS),
+        work / "d.log",
+        trigger=lambda: "power_test" in
+        {r.get("phase") for r in read_jsonl(run_state)},
+        what="power_test journaled in RUN_STATE.json")
+    d_log = (work / "d.log").read_text()
+    assert "[faults] injected" in d_log
+    assert "[resume]" in d_log, "resume run D skipped nothing"
+
+    # ---- E. clean resume runs to completion -------------------------
+    run_logged(bench_cmd(cfg_b, resume=True), base_env(),
+               work / "e.log", check_rc=0)
+    e_log = (work / "e.log").read_text()
+    assert "[resume] phase power_test already completed" in e_log, \
+        "final resume re-ran the power phase"
+
+    # every phase journaled; queries finished before the kills were
+    # skipped, the rest ran — union must equal the baseline set
+    phases = {r.get("phase") for r in read_jsonl(run_state)}
+    assert PHASES <= phases, f"missing phases in RUN_STATE: " \
+        f"{sorted(PHASES - phases)}"
+    assert completed_queries(progress) == base_done
+
+    # the power sidecar survives run E (phase skipped) and proves the
+    # mid-power resume: run D carried over run C's completed queries
+    sidecar = json.loads(
+        (pathlib.Path(str(root_b / "power.csv") + ".metrics.json"))
+        .read_text())
+    assert sidecar.get("resumed"), \
+        "power sidecar records no resumed (journal-skipped) queries"
+    assert set(sidecar["resumed"]) == killed_done
+
+    # ledger assertions: appended entries parse, and the retry layer
+    # annotated the recovered injected faults with the attempt count
+    ledger = read_jsonl(root_b / "ledger.jsonl")
+    assert ledger, "run ledger is empty"
+    retried = [e for e in ledger
+               if (e.get("extra") or {}).get("retry_attempts", 0) >= 2]
+    assert retried, "no ledger entry carries retry_attempts >= 2 " \
+        "(injected transient faults were not retry-recovered)"
+
+    # composite metric + query results match the fault-free baseline
+    chaos_metrics = dict(
+        line.split(",", 1) for line in
+        (root_b / "metrics.csv").read_text().splitlines())
+    assert set(chaos_metrics) == set(base_metrics)
+    assert int(chaos_metrics["metric"]) > 0
+    for k in ("scale_factor", "num_streams", "queries_per_stream"):
+        assert chaos_metrics[k] == base_metrics[k], k
+    import pyarrow.parquet as pq
+    for q in sorted(base_done):
+        a = pq.read_table(root_a / "out" / q)
+        b = pq.read_table(root_b / "out" / q)
+        assert a.equals(b), f"{q}: chaos-run result differs from baseline"
+    print(f"results identical to baseline for {len(base_done)} queries")
+
+    # ---- F. a permanent fault surfaces classified, never vanishes ---
+    perm_log = root_b / "power_perm.csv"
+    run_logged(
+        [sys.executable, "-m", "ndstpu.harness.power",
+         root_b / "streams" / "query_0.sql", root_b / "wh", perm_log,
+         "--engine", "cpu", "--sub_queries", "query3",
+         "--ledger", root_b / "ledger_perm.jsonl",
+         "--scale_factor", "0.002"],
+        base_env(NDSTPU_FAULTS="execute:permanent:1.0:seedP:times=1"),
+        work / "f.log")
+    perm_sidecar = json.loads(
+        pathlib.Path(str(perm_log) + ".metrics.json").read_text())
+    tax = (perm_sidecar.get("faultTaxonomy") or {}).get("counts") or {}
+    assert tax.get("permanent", 0) >= 1, \
+        f"permanent fault missing from sidecar taxonomy: {tax}"
+    verdicts = ((perm_sidecar.get("sentinel") or {}).get("counts")
+                or {})
+    assert verdicts.get("failed-permanent", 0) >= 1, \
+        f"no failed-permanent sentinel verdict: {verdicts}"
+
+    print("chaos smoke OK: crash + 2 SIGKILLs resumed to "
+          "baseline-identical results; permanent fault surfaced "
+          "classified")
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

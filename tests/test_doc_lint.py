@@ -86,3 +86,16 @@ def test_cli_exit_codes(tmp_path):
         capture_output=True, text=True, env=env)
     assert r.returncode == 1
     assert "NOT_THERE" in r.stdout
+
+
+def test_run_state_citation_is_recognized_but_runtime_exempt(tmp_path):
+    """`RUN_STATE.json` is a per-run resume journal
+    (docs/ROBUSTNESS.md): citing it must never demand a committed
+    file — while ghost doc artifacts in the same text still flag."""
+    text = ("the bench driver journals phases to `RUN_STATE.json`\n"
+            "and cites `docs/GHOST.json` for numbers\n")
+    (tmp_path / "docs").mkdir()
+    findings = artifact_lint.lint_text(text, str(tmp_path), doc="d.md")
+    assert len(findings) == 1
+    assert "GHOST" in findings[0]
+    assert not any("RUN_STATE" in f for f in findings)

@@ -1,0 +1,428 @@
+"""Robustness layer: injector grammar/determinism, failure taxonomy,
+retry/quarantine contract, atomic artifact writes, bench RUN_STATE
+journal, sentinel failed-<taxonomy> verdicts, and the throughput
+restart-once path (docs/ROBUSTNESS.md)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from ndstpu import faults
+from ndstpu.faults import injector, retry, taxonomy
+from ndstpu.harness import runstate, throughput
+from ndstpu.io import atomic
+from ndstpu.obs import ledger as ledger_mod
+from ndstpu.obs import sentinel
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults(monkeypatch):
+    """Tests own the injector: clear any ambient spec, and never leak
+    an installed one into other test modules."""
+    monkeypatch.delenv(injector.ENV_VAR, raising=False)
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+# ------------------------------------------------------------ injector
+
+def test_parse_rule_full_grammar():
+    r = injector._parse_rule("execute:transient:0.25:seed7:times=3:hang=2")
+    assert (r.site, r.kind, r.prob) == ("execute", "transient", 0.25)
+    assert r.seed == "7" and r.times == 3 and r.hang_s == 2.0
+    assert r.describe() == "execute:transient:0.25:seed7:times=3"
+
+
+def test_parse_spec_env_string_multi():
+    rules = faults.parse_spec(
+        "execute:transient:0.2:seed7, io.write:permanent:0.05")
+    assert [(r.site, r.kind) for r in rules] == \
+        [("execute", "transient"), ("io.write", "permanent")]
+    assert faults.parse_spec(None) == [] and faults.parse_spec("") == []
+
+
+def test_parse_spec_yaml_forms():
+    # single mapping, list of mappings, and list of strings all parse
+    one = faults.parse_spec({"site": "plan", "kind": "permanent",
+                             "prob": 0.5, "seed": 9})
+    assert len(one) == 1 and one[0].seed == "9"
+    mixed = faults.parse_spec([
+        {"site": "compile", "times": 2},
+        "stream.worker:hang:1.0:hang=0.1",
+    ])
+    assert mixed[0].kind == "transient" and mixed[0].prob == 1.0
+    assert mixed[1].kind == "hang" and mixed[1].hang_s == 0.1
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuchsite:transient:1.0",
+    "execute:explode:1.0",
+    "execute:transient:1.5",
+    "execute:transient",
+    "execute:transient:often",
+    "execute:transient:1.0:wat=1",
+])
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec(bad)
+    with pytest.raises(faults.FaultSpecError):
+        faults.parse_spec([{"kind": "transient"}])  # no site
+
+
+def test_fire_decision_is_deterministic_per_seed():
+    a = injector.FaultRule("execute", "transient", 0.3, seed="7")
+    b = injector.FaultRule("execute", "transient", 0.3, seed="7")
+    seq_a = [a.should_fire(i) for i in range(200)]
+    seq_b = [b.should_fire(i) for i in range(200)]
+    assert seq_a == seq_b and any(seq_a) and not all(seq_a)
+    c = injector.FaultRule("execute", "transient", 0.3, seed="8")
+    assert seq_a != [c.should_fire(i) for i in range(200)]
+
+
+def test_prob_bounds_always_and_never():
+    always = injector.FaultRule("plan", "permanent", 1.0)
+    never = injector.FaultRule("plan", "permanent", 0.0)
+    assert all(always.should_fire(i) for i in range(10))
+    assert not any(never.should_fire(i) for i in range(10))
+
+
+def test_times_bounds_injections_and_counters():
+    inj = injector.Injector(
+        faults.parse_spec("execute:transient:1.0:times=2"), out=lambda s: None)
+    for _ in range(2):
+        with pytest.raises(faults.InjectedTransient):
+            inj.check("execute", key="q")
+    inj.check("execute")  # budget spent: probe is a no-op now
+    assert inj.injected == {"execute": 2} and inj.calls["execute"] == 3
+
+
+def test_sites_are_independent():
+    inj = injector.Injector(faults.parse_spec("execute:permanent:1.0"),
+                            out=lambda s: None)
+    inj.check("plan")
+    inj.check("io.write")
+    with pytest.raises(faults.InjectedPermanent) as ei:
+        inj.check("execute")
+    assert ei.value.site == "execute" and ei.value.kind == "permanent"
+
+
+def test_hang_sleeps_instead_of_raising():
+    slept = []
+    inj = injector.Injector(
+        faults.parse_spec("compile:hang:1.0:hang=5"),
+        sleep=slept.append, out=lambda s: None)
+    inj.check("compile")  # returns normally after the simulated wedge
+    assert slept == [5.0]
+
+
+def test_module_probe_noop_until_installed():
+    faults.check("execute")  # nothing installed: no-op
+    faults.install("execute:transient:1.0")
+    with pytest.raises(faults.InjectedTransient):
+        faults.check("execute", key="query1")
+    faults.uninstall()
+    faults.check("execute")
+    assert faults.active() is None
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv(injector.ENV_VAR, "plan:permanent:1.0:seed3")
+    inj = faults.install_from_env()
+    assert inj is faults.active() and inj.rules[0].seed == "3"
+    monkeypatch.delenv(injector.ENV_VAR)
+    assert faults.install_from_env() is None
+
+
+# ------------------------------------------------------------ taxonomy
+
+def test_classify_injected_faults():
+    assert taxonomy.classify(
+        faults.InjectedTransient("x", "execute")) == taxonomy.TRANSIENT
+    assert taxonomy.classify(
+        faults.InjectedPermanent("x", "plan")) == taxonomy.PERMANENT
+
+
+@pytest.mark.parametrize("exc,klass", [
+    (TimeoutError("watchdog abandoned query"), taxonomy.TRANSIENT),
+    (ConnectionResetError("peer"), taxonomy.TRANSIENT),
+    (ValueError("bad literal"), taxonomy.PERMANENT),
+    (NotImplementedError("rollup"), taxonomy.PERMANENT),
+    (RuntimeError("DEADLINE EXCEEDED while waiting"), taxonomy.TRANSIENT),
+    (RuntimeError("segfault in kernel"), taxonomy.PERMANENT),  # unknown
+])
+def test_classify_types_and_messages(exc, klass):
+    assert taxonomy.classify(exc) == klass
+
+
+def test_classify_kind_attribute_wins():
+    e = RuntimeError("mystery")
+    e.kind = "transient"
+    assert taxonomy.classify(e) == taxonomy.TRANSIENT
+
+
+def test_classify_name_sentinel_path():
+    # permanent type names beat transient message keywords
+    assert taxonomy.classify_name("PlanError", "timed out") == \
+        taxonomy.PERMANENT
+    assert taxonomy.classify_name("JaxRuntimeError",
+                                  "connection reset by peer") == \
+        taxonomy.TRANSIENT
+    assert taxonomy.classify_name("SomethingNew") == taxonomy.PERMANENT
+
+
+# -------------------------------------------------------------- retry
+
+def _policy(n):
+    return retry.RetryPolicy(max_attempts=n)
+
+
+def test_retry_recovers_transient():
+    calls, sleeps = [], []
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise faults.InjectedTransient("flaky", "execute")
+        return 42
+    result, attempts = retry.run_with_retry(
+        fn, "query1", policy=_policy(2), sleep=sleeps.append,
+        out=lambda s: None)
+    assert (result, attempts) == (42, 2)
+    assert sleeps == [0.05]  # deterministic: base backoff, no jitter
+
+
+def test_retry_permanent_raises_immediately():
+    calls = []
+    def fn():
+        calls.append(1)
+        raise faults.InjectedPermanent("broken", "plan")
+    with pytest.raises(faults.InjectedPermanent) as ei:
+        retry.run_with_retry(fn, "query1", policy=_policy(3),
+                             sleep=lambda s: None, out=lambda s: None)
+    assert len(calls) == 1
+    assert ei.value.taxonomy == taxonomy.PERMANENT
+    assert ei.value.attempts == 1
+
+
+def test_retry_exhausted_with_deterministic_backoff():
+    sleeps = []
+    def fn():
+        raise TimeoutError("rpc deadline")
+    with pytest.raises(TimeoutError) as ei:
+        retry.run_with_retry(fn, "query1", policy=_policy(3),
+                             sleep=sleeps.append, out=lambda s: None)
+    assert sleeps == [0.05, 0.1]  # pure doubling
+    assert ei.value.taxonomy == taxonomy.TRANSIENT
+    assert ei.value.attempts == 3
+
+
+def test_retry_policy_backoff_cap_and_env():
+    p = retry.RetryPolicy()
+    assert p.backoff_s(10) == retry.DEFAULT_MAX_BACKOFF_S
+    assert retry.RetryPolicy.from_env({"NDSTPU_RETRY_MAX": "5"}) \
+        .max_attempts == 5
+    assert retry.RetryPolicy.from_env({"NDSTPU_RETRY_MAX": "zero"}) \
+        .max_attempts == retry.DEFAULT_MAX_ATTEMPTS
+    assert retry.RetryPolicy.from_env({"NDSTPU_RETRY_MAX": "0"}) \
+        .max_attempts == 1  # clamped: at least one attempt
+    with pytest.raises(ValueError):
+        retry.RetryPolicy(max_attempts=0)
+
+
+def test_quarantine_poison_list():
+    q = retry.Quarantine(max_failures=2)
+    assert not q.note_failure("query5", "transient")
+    assert not q.is_quarantined("query5")
+    assert q.note_failure("query5", "permanent")  # tips into quarantine
+    assert q.is_quarantined("query5")
+    assert q.failures("query5") == ["transient", "permanent"]
+    assert not q.note_failure("query6", "transient")
+    assert not q.is_quarantined("query6")  # keys are independent
+    assert "max_failures=2" in q.reason("query5")
+    assert list(q.snapshot()) == ["query5"]  # only quarantined keys
+
+
+def test_retry_feeds_quarantine():
+    q = retry.Quarantine(max_failures=2)
+    def fn():
+        raise faults.InjectedPermanent("broken", "execute")
+    for _ in range(2):
+        with pytest.raises(faults.InjectedPermanent):
+            retry.run_with_retry(fn, "query9", policy=_policy(1),
+                                 quarantine=q, sleep=lambda s: None,
+                                 out=lambda s: None)
+    assert q.is_quarantined("query9")
+    assert q.snapshot()["query9"] == ["permanent", "permanent"]
+
+
+# ------------------------------------------------------------- atomic
+
+def test_atomic_write_and_read_back(tmp_path):
+    p = tmp_path / "a" / "doc.json"
+    atomic.atomic_write_json(str(p), {"k": [1, 2]})
+    with open(p) as f:
+        assert json.load(f) == {"k": [1, 2]}
+    atomic.atomic_write_text(str(p), "hello\n")
+    assert p.read_text() == "hello\n"
+    atomic.atomic_write_bytes(str(p), b"\x00\x01")
+    assert p.read_bytes() == b"\x00\x01"
+
+
+def test_atomic_writer_refuses_append(tmp_path):
+    with pytest.raises(ValueError):
+        with atomic.atomic_writer(str(tmp_path / "x"), "a"):
+            pass
+
+
+def test_atomic_writer_leaves_no_partial_file(tmp_path):
+    p = tmp_path / "doc.json"
+    atomic.atomic_write_text(str(p), "old complete artifact")
+    with pytest.raises(RuntimeError):
+        with atomic.atomic_writer(str(p)) as f:
+            f.write("half of the new")
+            raise RuntimeError("crash mid-write")
+    # old artifact intact, temp file cleaned up
+    assert p.read_text() == "old complete artifact"
+    assert [x.name for x in tmp_path.iterdir()] == ["doc.json"]
+
+
+def test_append_jsonl_and_torn_tail(tmp_path):
+    j = str(tmp_path / "journal.jsonl")
+    atomic.append_jsonl(j, {"n": 1})
+    atomic.append_jsonl(j, {"n": 2})
+    with open(j, "a") as f:
+        f.write('{"n": 3, "tr')  # crash mid-append: torn final line
+    assert atomic.read_jsonl(j) == [{"n": 1}, {"n": 2}]
+    assert atomic.read_jsonl(str(tmp_path / "missing.jsonl")) == []
+    # a torn line that is NOT final means corruption, not a crash
+    with open(j, "a") as f:
+        f.write('uncated\n{"n": 4}\n')
+    with pytest.raises(ValueError):
+        atomic.read_jsonl(j)
+
+
+def test_io_write_fault_fires_through_atomic_helpers(tmp_path):
+    faults.install("io.write:permanent:1.0:times=1")
+    p = str(tmp_path / "doc.json")
+    with pytest.raises(faults.InjectedPermanent):
+        atomic.atomic_write_json(p, {"k": 1})
+    assert not os.path.exists(p)  # fault fired before any bytes
+    atomic.atomic_write_json(p, {"k": 1})  # times=1: budget spent
+    assert os.path.exists(p)
+
+
+# ----------------------------------------------------------- runstate
+
+def _bench_params(**over):
+    params = {
+        "power_test": {"engine": "cpu", "scale_factor": 0.01,
+                       "budget_s": 60},
+        "load_test": {"warehouse": "/wh"},
+        "observability": {"ledger": "/tmp/led.jsonl"},
+        "metrics": {"metrics_report": "/tmp/m.csv"},
+    }
+    params.update(over)
+    return params
+
+
+def test_config_fingerprint_ignores_obs_and_budget():
+    fp = runstate.config_fingerprint(_bench_params())
+    assert fp == runstate.config_fingerprint(_bench_params(
+        observability={"ledger": "/elsewhere.jsonl"}))
+    assert fp == runstate.config_fingerprint(_bench_params(
+        power_test={"engine": "cpu", "scale_factor": 0.01,
+                    "budget_s": 5}))
+    # a real config change (engine) must invalidate the journal
+    assert fp != runstate.config_fingerprint(_bench_params(
+        power_test={"engine": "tpu", "scale_factor": 0.01}))
+
+
+def test_runstate_mark_completed_reset(tmp_path):
+    path = str(tmp_path / runstate.DEFAULT_BASENAME)
+    st = runstate.RunState(path, "fp1")
+    assert st.completed_phases() == set()
+    st.mark("load_test", artifacts=["/wh"])
+    st.mark("power_test")
+    assert st.completed_phases() == {"load_test", "power_test"}
+    assert st.records()[0]["artifacts"] == ["/wh"]
+    # a different fingerprint never splices in another config's phases
+    assert runstate.RunState(path, "fp2").completed_phases() == set()
+    st.reset()
+    assert not os.path.exists(path) and st.completed_phases() == set()
+
+
+# ----------------------------------------------- sentinel taxonomy split
+
+def test_sentinel_splits_failed_by_taxonomy():
+    led = ledger_mod.Ledger(path=None)
+    qsums = [
+        {"query": "query1", "wall_s": 0.1,
+         "attrs": {"error": "InjectedTransient: flaky",
+                   "error_taxonomy": "transient", "error_attempts": 2}},
+        {"query": "query2", "wall_s": 0.1,
+         "attrs": {"error": "PlanError: no",
+                   "error_taxonomy": "permanent", "error_attempts": 1}},
+        # a failure that never went through the retry layer keeps the
+        # bare verdict (tests/test_ledger.py pins this invariant too)
+        {"query": "query3", "wall_s": 0.1, "attrs": {"error": "boom"}},
+    ]
+    res = sentinel.classify_run(qsums, led, engine="cpu",
+                                scale_factor="1")
+    assert res["counts"] == {"failed-transient": 1,
+                             "failed-permanent": 1, "failed": 1}
+    by_q = {v["query"]: v for v in res["verdicts"]}
+    assert by_q["query1"]["attempts"] == 2
+    assert res["regressions"] == []
+    md = sentinel.markdown_table(res)
+    assert "failed-transient" in md and "failed-permanent" in md
+
+
+# ------------------------------------------- throughput restart-once
+
+def _flaky_stream_script(tmp_path, fail_rc, then_succeed):
+    """A stand-in stream process: exits ``fail_rc`` on the first run
+    for a given stream id and, when ``then_succeed``, 0 afterwards."""
+    script = tmp_path / "stream.py"
+    script.write_text(textwrap.dedent(f"""\
+        import pathlib, sys
+        marker = pathlib.Path(sys.argv[1]) / ("ran_" + sys.argv[2])
+        if marker.exists() and {then_succeed!r}:
+            sys.exit(0)
+        marker.touch()
+        sys.exit({fail_rc})
+        """))
+    return str(script)
+
+
+def test_throughput_restarts_failed_stream_once(tmp_path, capsys):
+    script = _flaky_stream_script(tmp_path, fail_rc=3, then_succeed=True)
+    report = str(tmp_path / "overlap.json")
+    rc = throughput.run_throughput(
+        ["0", "1"], [sys.executable, script, str(tmp_path), "{}"],
+        overlap_report=report)
+    assert rc == 0  # both streams recovered on their restart
+    out = capsys.readouterr().out
+    assert "restarting once (taxonomy: transient)" in out
+    with open(report) as f:
+        doc = json.load(f)
+    assert len(doc["streams"]) == 2
+    for rec in doc["streams"]:
+        assert rec["returncode"] == 0 and rec["restarts"] == 1
+        assert rec["first_attempt"]["returncode"] == 3
+        assert rec["taxonomy"] == taxonomy.TRANSIENT
+
+
+def test_throughput_restart_exhausted_is_permanent(tmp_path):
+    script = _flaky_stream_script(tmp_path, fail_rc=4, then_succeed=False)
+    report = str(tmp_path / "overlap.json")
+    rc = throughput.run_throughput(
+        ["0"], [sys.executable, script, str(tmp_path), "{}"],
+        overlap_report=report)
+    assert rc == 4  # restart budget is ONE: second failure is final
+    with open(report) as f:
+        rec = json.load(f)["streams"][0]
+    assert rec["restarts"] == 1 and rec["returncode"] == 4
+    assert rec["taxonomy"] == taxonomy.PERMANENT

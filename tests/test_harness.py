@@ -517,3 +517,116 @@ def test_metric_formula():
     assert bench_mod.round_up_to_nearest_10_percent(1.01) == 1.1
     assert bench_mod.get_stream_range(9, 1) == [1, 2, 3, 4]
     assert bench_mod.get_stream_range(9, 2) == [5, 6, 7, 8]
+
+
+# --------------------------------------- robustness (docs/ROBUSTNESS.md)
+
+def test_power_resume_skips_journaled_queries(dataset, env, tmp_path):
+    """Crash-safe power resume: the per-query progress journal lets a
+    second run of the same fingerprint skip every finished query and
+    carry its time-log rows over."""
+    time_log = tmp_path / "time.csv"
+    cmd = ["python", "-m", "ndstpu.harness.power",
+           str(dataset / "streams" / "query_0.sql"),
+           str(dataset / "wh"), str(time_log),
+           "--input_format", "ndslake",
+           "--sub_queries", "query3,query42"]
+    subprocess.run(cmd, check=True, env=env)
+    journal = tmp_path / "time.csv.progress.jsonl"
+    recs = [json.loads(line) for line
+            in journal.read_text().splitlines()]
+    assert [r["query"] for r in recs] == ["query3", "query42"]
+    assert len({r["fp"] for r in recs}) == 1
+
+    r = subprocess.run(cmd + ["--resume"], check=True, env=env,
+                       capture_output=True, text=True)
+    assert "Skip query3 (resume: already completed)" in r.stdout
+    assert "Skip query42 (resume: already completed)" in r.stdout
+    # carried-over rows keep the time-log contract intact
+    text = time_log.read_text()
+    assert "query3" in text and "Power Test Time" in text
+    sidecar = json.loads(
+        (tmp_path / "time.csv.metrics.json").read_text())
+    assert sidecar["resumed"] == ["query3", "query42"]
+
+
+def test_power_resume_ignores_other_fingerprint(dataset, env, tmp_path):
+    """A journal written under different run parameters (here: another
+    query subset) must never satisfy a resume."""
+    time_log = tmp_path / "time.csv"
+    base = ["python", "-m", "ndstpu.harness.power",
+            str(dataset / "streams" / "query_0.sql"),
+            str(dataset / "wh"), str(time_log),
+            "--input_format", "ndslake"]
+    subprocess.run(base + ["--sub_queries", "query3"],
+                   check=True, env=env)
+    r = subprocess.run(
+        base + ["--sub_queries", "query42", "--resume"],
+        check=True, env=env, capture_output=True, text=True)
+    assert "Skip" not in r.stdout  # fingerprint mismatch: full rerun
+    sidecar = json.loads(
+        (tmp_path / "time.csv.metrics.json").read_text())
+    assert sidecar["resumed"] is None
+
+
+def test_power_watchdog_abandons_hung_query_and_reports_zombie(
+        dataset, env, tmp_path):
+    """A wedged execute on an accel engine is abandoned by the
+    per-query watchdog (TimeoutError -> transient taxonomy), the stream
+    swaps in a fresh session, and the abandoned thread surfaces as
+    `zombieQueries` in the NEXT query's summary after its one grace
+    join (docs/ROBUSTNESS.md)."""
+    jdir = tmp_path / "json"
+    time_log = tmp_path / "time.csv"
+    # 15s watchdog: an order of magnitude above this stream's real
+    # per-query cost (~4s compile+run at this SF) so only the injected
+    # 120s hang trips it; the hang outlives the 10s zombie grace join
+    hang_env = dict(
+        env,
+        NDSTPU_FAULTS="execute:hang:1.0:seedZ:times=1:hang=120",
+        NDSTPU_POWER_QUERY_TIMEOUT_S="15",
+        NDSTPU_RETRY_MAX="1")
+    subprocess.run(
+        ["python", "-m", "ndstpu.harness.power",
+         str(dataset / "streams" / "query_0.sql"),
+         str(dataset / "wh"), str(time_log),
+         "--input_format", "ndslake",
+         "--engine", "tpu",
+         "--sub_queries", "query3,query42",
+         "--json_summary_folder", str(jdir)],
+        check=True, env=hang_env)
+    s3 = json.loads(next(jdir.glob("*-query3-*.json")).read_text())
+    assert s3["queryStatus"] == ["Failed"]
+    assert any("abandoned" in e or "TimeoutError" in e
+               for e in s3["exceptions"]), s3["exceptions"]
+    s42 = json.loads(next(jdir.glob("*-query42-*.json")).read_text())
+    assert s42["queryStatus"] == ["Completed"]
+    assert s42["zombieQueries"] == ["query3"]
+    sidecar = json.loads(
+        (tmp_path / "time.csv.metrics.json").read_text())
+    assert sidecar["faultTaxonomy"]["counts"] == {"transient": 1}
+    assert sidecar["faultTaxonomy"]["queries"]["query3"] == "transient"
+
+
+def test_transcode_resume_markers(dataset, env, tmp_path):
+    """_SUCCESS markers: resume skips completed tables and rebuilds a
+    torn (marker-less) table dir from scratch."""
+    out = tmp_path / "wh"
+    cmd = ["python", "-m", "ndstpu.io.transcode",
+           "--input_prefix", str(dataset / "raw"),
+           "--output_prefix", str(out),
+           "--report_file", str(tmp_path / "load.txt"),
+           "--output_format", "ndslake"]
+    subprocess.run(cmd, check=True, env=env,
+                   stdout=subprocess.DEVNULL)
+    markers = list(out.glob("*/_SUCCESS"))
+    assert markers  # every table dir is marked complete
+    # simulate a crash mid-write on one table: kill its marker
+    torn = markers[0].parent
+    markers[0].unlink()
+    r = subprocess.run(cmd + ["--resume"], check=True, env=env,
+                       capture_output=True, text=True)
+    assert f"[resume] {torn.name}: incomplete output" in r.stdout
+    assert r.stdout.count("_SUCCESS marker present — skipping") == \
+        len(markers) - 1
+    assert (torn / "_SUCCESS").exists()  # rebuilt and re-marked
