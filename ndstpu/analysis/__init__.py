@@ -23,15 +23,17 @@ import numpy as np
 
 from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
-from ndstpu.analysis import diagnostics, lowering, typecheck
+from ndstpu.analysis import canon, diagnostics, lowering, typecheck
+from ndstpu.analysis.canon import CanonResult, canonicalize
 from ndstpu.analysis.diagnostics import Diagnostic
 from ndstpu.analysis.lowering import audit_plan
 from ndstpu.analysis.typecheck import infer_plan
 
 __all__ = [
-    "AnalysisResult", "Diagnostic", "analyze_plan", "analyze_sql",
-    "audit_plan", "diagnostics", "infer_plan", "lowering",
-    "schema_catalog", "schema_tables", "typecheck",
+    "AnalysisResult", "CanonResult", "Diagnostic", "analyze_plan",
+    "analyze_sql", "audit_plan", "canon", "canonicalize", "diagnostics",
+    "infer_plan", "lowering", "schema_catalog", "schema_tables",
+    "typecheck",
 ]
 
 
@@ -68,8 +70,9 @@ class AnalysisResult:
 
     query: str
     verdict: str                      # "device" | "fallback"
-    diagnostics: List[Diagnostic]     # NDS1xx + NDS2xx + NDS3xx, sorted
+    diagnostics: List[Diagnostic]     # NDS1xx..NDS4xx, sorted
     schema: typecheck.Schema
+    canon: Optional[CanonResult] = None   # plan-shape canonicalization
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -93,9 +96,12 @@ def analyze_plan(plan, tables: Optional[Dict[str, object]] = None,
                                         scale_factor=scale_factor)
     audit = audit_plan(plan, tables, query=query,
                        scale_factor=scale_factor, spmd=spmd)
-    diags = diagnostics.sort_diagnostics(type_diags + audit.diagnostics)
+    cres = canonicalize(plan, tables=tables, query=query)
+    diags = diagnostics.sort_diagnostics(
+        type_diags + audit.diagnostics + list(cres.diagnostics))
     return AnalysisResult(query=query, verdict=audit.verdict,
-                          diagnostics=diags, schema=out_schema)
+                          diagnostics=diags, schema=out_schema,
+                          canon=cres)
 
 
 def analyze_sql(session, query: str, sql: str,

@@ -17,6 +17,9 @@ Code ranges (docs/ARCHITECTURE.md "Static analysis"):
 * ``NDS2xx`` — single-chip device lowering (analysis/lowering.py, mirrors
   jaxexec's raise sites)
 * ``NDS3xx`` — SPMD / distributed spine (mirrors parallel/dplan.py)
+* ``NDS4xx`` — plan canonicalization / parameter lifting
+  (analysis/canon.py): which literal slots bind at runtime vs stay baked
+  into the compiled program's shape
 
 The module is import-hygienic: no jax, no engine imports — it can run in
 a process that never initializes a backend (CI lint, doc tooling).
@@ -62,6 +65,17 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NDS305": ("info", "predicted exchange placement (broadcast/shuffle)"),
     "NDS306": ("info", "row spine does no distributed work"),
     "NDS307": ("warning", "join key kind not shardable on the spine"),
+    # -- NDS4xx canonicalization / parameter lifting ----------------------
+    "NDS401": ("info", "shape-affecting literal: value feeds static shape "
+                       "or capacity planning (LIMIT, interval width, "
+                       "bounded CASE value, group key)"),
+    "NDS402": ("info", "literal inside a pre-resolved subquery is baked "
+                       "into the recorded size plan"),
+    "NDS403": ("info", "literal in a host-static context cannot bind at "
+                       "runtime (function argument, non-predicate string, "
+                       "unclean IN-list)"),
+    "NDS404": ("warning", "corpus part does not collapse to one canonical "
+                          "fingerprint across probed streams/seeds"),
 }
 
 _SEV_ORDER = {"error": 0, "warning": 1, "info": 2}

@@ -371,6 +371,13 @@ class LoweringAuditor:
             self._check_in_list(e, schema, path)
             self._expr(e.operand, schema, path, allow_agg)
             return
+        if isinstance(e, ex.Param):
+            # lifted literal (analysis/canon.py): binds a supported-type
+            # value at runtime, lowerable wherever a Literal is
+            return
+        if isinstance(e, ex.InParam):
+            self._expr(e.operand, schema, path, allow_agg)
+            return
         if isinstance(e, ex.SubqueryExpr):
             if e.kind not in DEVICE_SUBQUERY_KINDS:
                 self._emit("NDS211", f"subquery kind {e.kind} is "

@@ -349,6 +349,13 @@ class TypeChecker:
         if isinstance(e, ex.InList):
             t = self.expr_type(e.operand, schema)
             return ColType(BOOL, t.nullable)
+        if isinstance(e, ex.Param):
+            # lifted literal (analysis/canon.py): typed like the literal
+            # it replaced — parameters are never NULL (None is not lifted)
+            return ColType(e.ctype, False)
+        if isinstance(e, ex.InParam):
+            t = self.expr_type(e.operand, schema)
+            return ColType(BOOL, t.nullable)
         if isinstance(e, ex.AggExpr):
             arg_t = UNKNOWN if isinstance(e.arg, ex.Star) else \
                 self.expr_type(e.arg, schema)

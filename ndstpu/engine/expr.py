@@ -16,9 +16,11 @@ TPU path.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import fnmatch
 import re
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -136,6 +138,78 @@ class InList(Expr):
 
     def children(self):
         return (self.operand,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Param(Expr):
+    """A literal lifted into a typed runtime-parameter slot by
+    ndstpu.analysis.canon.  The slot's value travels outside the plan (in
+    the canonical binding) so structurally identical queries share one
+    compiled program.  `shape=True` marks slots whose value participates
+    in static shape planning; those are substituted back to concrete
+    literals before execution and exist only for fingerprinting."""
+
+    slot: int
+    ctype: DType  # always resolved by the canonicalizer, never None
+    shape: bool = False
+
+    def __repr__(self):
+        k = "S" if self.shape else "P"
+        return f"param({k}{self.slot}:{self.ctype!r})"
+
+
+@dataclasses.dataclass(frozen=True)
+class InParam(Expr):
+    """An IN-list whose value tuple is lifted into one parameter slot.
+    The arity is static (part of the compiled program's shape); only the
+    member values are bound at execution time."""
+
+    operand: Expr
+    slot: int
+    n: int
+    negated: bool = False
+
+    def children(self):
+        return (self.operand,)
+
+    def __repr__(self):
+        neg = "not " if self.negated else ""
+        return f"inparam({self.operand} {neg}in P{self.slot}[{self.n}])"
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamBinding:
+    """Slot values for one execution of a canonical plan.
+
+    ``values`` is indexed by slot id (IN-list slots hold the value tuple,
+    shape slots hold the substituted-back literal).  ``scalars`` lists the
+    runtime-bindable scalar slots with their resolved types — the compiled
+    program declares one traced argument per entry, so the set must be a
+    pure function of the canonical fingerprint (it is: both derive from
+    the same canonicalization)."""
+
+    values: Tuple[object, ...]
+    scalars: Tuple[Tuple[int, DType], ...] = ()
+
+
+# Active parameter binding for the numpy evaluator (and any fallback path
+# that re-evaluates canonical subtrees host-side).  Thread-local because
+# harness streams share one Session from worker threads.
+_PARAMS = threading.local()
+
+
+def active_params() -> Optional[Tuple[object, ...]]:
+    return getattr(_PARAMS, "values", None)
+
+
+@contextlib.contextmanager
+def bound_params(values: Optional[Sequence[object]]):
+    prev = getattr(_PARAMS, "values", None)
+    _PARAMS.values = tuple(values) if values is not None else None
+    try:
+        yield
+    finally:
+        _PARAMS.values = prev
 
 
 @dataclasses.dataclass(frozen=True)
@@ -461,6 +535,19 @@ class Evaluator:
             return self._func(e)
         if isinstance(e, InList):
             return self._in_list(e)
+        if isinstance(e, Param):
+            vals = active_params()
+            if vals is None or e.shape:
+                raise RuntimeError(
+                    f"unbound parameter slot {e.slot} reached evaluation")
+            return literal_column(vals[e.slot], self.n, e.ctype)
+        if isinstance(e, InParam):
+            vals = active_params()
+            if vals is None:
+                raise RuntimeError(
+                    f"unbound parameter slot {e.slot} reached evaluation")
+            return self._in_list(
+                InList(e.operand, tuple(vals[e.slot]), e.negated))
         if isinstance(e, SubqueryExpr):
             raise RuntimeError(
                 "unresolved subquery reached evaluation — planner bug")

@@ -41,6 +41,7 @@ to host once).
 from __future__ import annotations
 
 import os
+import contextlib
 import dataclasses
 import math
 import re
@@ -559,6 +560,171 @@ def _merged_dict(cols: Sequence[DCol]) -> np.ndarray:
     return np.unique(np.concatenate(parts))
 
 
+# ---------------------------------------------------------------------------
+# runtime parameter binding (canonical plans — analysis/canon.py)
+# ---------------------------------------------------------------------------
+#
+# Canonicalized plans carry ex.Param / ex.InParam where the SQL text had
+# literals; the concrete values travel OUTSIDE the plan as an
+# ex.ParamBinding, so one traced program serves every rendering of a
+# template.  Scalars become broadcast columns (no point bounds — bounds
+# would bake the value back into the traced program); string parameters
+# become host-computed hit tables over the operand's dictionary, exactly
+# like literal string predicates, except the table is a replay ARGUMENT
+# instead of a traced constant.  During discovery every table/vector
+# materialization is recorded into the program's ``param_spec`` so the
+# replay argument subtree can be rebuilt for any later binding; the
+# jitted replay pops the spec positionally, mirroring the size-plan
+# record discipline.
+
+_ACTIVE_PARAMS = threading.local()
+
+
+def _active_params() -> Optional["_ParamCtx"]:
+    return getattr(_ACTIVE_PARAMS, "ctx", None)
+
+
+def _param_scalar_np(value, ctype: DType):
+    """Host conversion of one bound scalar to its device representation
+    (mirrors JEval._lit dtype choices, minus the point bounds)."""
+    if ctype.kind == "bool":
+        return np.bool_(value)
+    if ctype.kind == "decimal":
+        v = value * 10 ** ctype.scale if isinstance(value, int) \
+            else round(value * 10 ** ctype.scale)
+        return np.int64(v)
+    if ctype.kind == "float64":
+        return np.float64(value)
+    if ctype.kind in ("int32", "date"):
+        return np.int32(value)
+    if ctype.kind == "int64":
+        return np.int64(value)
+    raise Unsupported(f"parameter scalar {ctype.kind}", code="NDS201")
+
+
+_PDICT_OPS = {
+    "=": lambda a, b: a == b, "<>": lambda a, b: a != b,
+    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+}
+
+
+def _pdict_hits(value, op: str, swapped: bool, dictionary) -> np.ndarray:
+    """Hit table over a sorted string dictionary for one bound string
+    value (or IN tuple): len(dict)+1 bools, last entry False so the -1
+    NULL code gathers False (cf. _dict_lookup_bool).  Host python string
+    comparison matches np.unique's lexicographic dictionary order, so
+    ordered operators agree with the merged-dict literal path."""
+    if op == "in":
+        vals = set(str(v) for v in value)
+        hits = [str(x) in vals for x in dictionary]
+    else:
+        fn = _PDICT_OPS[op]
+        v = str(value)
+        hits = [fn(v, str(x)) if swapped else fn(str(x), v)
+                for x in dictionary]
+    return np.asarray(hits + [False], dtype=bool)
+
+
+def _pvec_np(values, ctype: DType) -> np.ndarray:
+    """Coerced device-representation vector for a bound IN-list over a
+    numeric/date operand (mirrors JEval._in_list's literal path: decimal
+    values arrive scale-shifted from coerce_in_values)."""
+    vals, _had_null = ex.coerce_in_values(ctype, values)
+    if ctype.kind == "float64":
+        return np.array(vals, dtype=np.float64)
+    return np.array(vals, dtype=np.int64)
+
+
+class _ParamCtx:
+    """One execution's bound parameters.
+
+    mode ``concrete``: ``values`` holds python literals; hit tables and
+    vectors are computed on host directly (and appended to ``spec`` when
+    ``record`` is set, i.e. during discovery).  mode ``trace``: inside
+    the jitted replay — scalars/tables/vectors are read from the traced
+    ``"\\x00params"`` argument subtree; non-scalar entries pop ``spec``
+    positionally, exactly like the size-plan record."""
+
+    def __init__(self, values, mode: str, spec: Optional[list] = None,
+                 traced: Optional[dict] = None, record: bool = False):
+        self.values = values
+        self.mode = mode
+        self.spec = spec if spec is not None else []
+        self.pos = 0
+        self.traced = traced if traced is not None else {}
+        self.record = record
+
+    def _pop(self, kind: str) -> int:
+        j = self.pos
+        self.pos += 1
+        if j >= len(self.spec) or self.spec[j][0] != kind:
+            raise RuntimeError(f"param-spec drift (expected {kind})")
+        return j
+
+    def scalar(self, slot: int, ctype: DType, cap: int) -> DCol:
+        if self.mode == "trace":
+            v = self.traced[f"s{slot}"]
+        else:
+            v = _param_scalar_np(self.values[slot], ctype)
+        data = jnp.broadcast_to(jnp.asarray(v), (cap,))
+        return DCol(data, jnp.ones(cap, bool), ctype)
+
+    def str_table(self, slot: int, op: str, swapped: bool,
+                  dictionary) -> jnp.ndarray:
+        if self.mode == "trace":
+            return self.traced[f"d{self._pop('pdict')}"]
+        if self.record:
+            self.spec.append(("pdict", slot, op, swapped,
+                              np.asarray(dictionary, dtype=object)))
+        return jnp.asarray(
+            _pdict_hits(self.values[slot], op, swapped, dictionary))
+
+    def num_vec(self, slot: int, ctype: DType) -> jnp.ndarray:
+        if self.mode == "trace":
+            return self.traced[f"v{self._pop('pvec')}"]
+        if self.record:
+            self.spec.append(("pvec", slot, ctype))
+        return jnp.asarray(_pvec_np(self.values[slot], ctype))
+
+
+@contextlib.contextmanager
+def _params_bound(ctx: Optional[_ParamCtx]):
+    """Install a parameter context for the device evaluator AND — when
+    concrete values are present — the numpy fallback path
+    (ex.bound_params) for the dynamic extent."""
+    prev = getattr(_ACTIVE_PARAMS, "ctx", None)
+    _ACTIVE_PARAMS.ctx = ctx
+    try:
+        if ctx is not None and ctx.values is not None:
+            with ex.bound_params(ctx.values):
+                yield
+        else:
+            yield
+    finally:
+        _ACTIVE_PARAMS.ctx = prev
+
+
+def _param_args_np(spec, binding: Optional[ex.ParamBinding]) -> dict:
+    """Host argument subtree (the ``"\\x00params"`` replay input) for one
+    program under one binding: every bindable scalar slot plus one hit
+    table / coerced vector per recorded spec entry."""
+    out = {}
+    if binding is None:
+        return out
+    for slot, ctype in binding.scalars:
+        out[f"s{slot}"] = _param_scalar_np(binding.values[slot], ctype)
+    for j, ent in enumerate(spec or ()):
+        if ent[0] == "pdict":
+            _tag, slot, op, swapped, dic = ent
+            out[f"d{j}"] = _pdict_hits(binding.values[slot], op,
+                                       swapped, dic)
+        else:
+            _tag, slot, ctype = ent
+            out[f"v{j}"] = _pvec_np(binding.values[slot], ctype)
+    return out
+
+
 class JEval:
     """Evaluates an Expr over a DTable with jnp ops (traceable)."""
 
@@ -753,6 +919,10 @@ class JEval:
             return self._func(e)
         if isinstance(e, ex.InList):
             return self._in_list(e)
+        if isinstance(e, ex.Param):
+            return self._param(e)
+        if isinstance(e, ex.InParam):
+            return self._in_param(e)
         raise Unsupported(f"expr {type(e).__name__}", code="NDS201")
 
     # -- operators -----------------------------------------------------------
@@ -772,6 +942,10 @@ class JEval:
                 data = ld | rd
                 valid = (lc.valid & rc.valid) | ld | rd
             return DCol(data, valid, BOOL)
+        if op in self._CMP:
+            pc = self._param_compare(e, op)
+            if pc is not None:
+                return pc
         lc, rc = self.eval(e.left), self.eval(e.right)
         if op in self._CMP:
             return self._compare(op, lc, rc)
@@ -952,6 +1126,61 @@ class JEval:
         if e.negated:
             # x NOT IN (..., NULL) is never TRUE (NULL semantics)
             data = jnp.zeros_like(data) if had_null else ~data
+        return DCol(data, c.valid, BOOL)
+
+    # -- bound parameters (canonical plans) ----------------------------------
+
+    def _param(self, e: ex.Param) -> DCol:
+        ctx = _active_params()
+        if ctx is None or e.shape:
+            raise Unsupported(f"unbound parameter S{e.slot}",
+                              code="NDS201")
+        if e.ctype.kind == "string":
+            # string scalars only bind through the dictionary-compare /
+            # IN intercepts; reaching generic eval means the
+            # canonicalizer lifted a string the device cannot broadcast
+            raise Unsupported("string parameter outside dictionary "
+                              "context", code="NDS206")
+        return ctx.scalar(e.slot, e.ctype, self.cap)
+
+    def _param_compare(self, e: ex.BinOp, op: str) -> Optional[DCol]:
+        """String-parameter comparison: host hit table over the other
+        side's dictionary (the parametric twin of the literal-string
+        merged-dict path)."""
+        ctx = _active_params()
+        if ctx is None:
+            return None
+        for par, other, swapped in ((e.right, e.left, False),
+                                    (e.left, e.right, True)):
+            if isinstance(par, ex.Param) and not par.shape and \
+                    par.ctype.kind == "string":
+                oc = self.eval(other)
+                if oc.ctype.kind != "string" or oc.dictionary is None:
+                    raise Unsupported("string parameter vs non-dictionary"
+                                      " operand", code="NDS206")
+                table = ctx.str_table(par.slot, op, swapped,
+                                      oc.dictionary)
+                return DCol(table[oc.data], oc.valid, BOOL)
+        return None
+
+    def _in_param(self, e: ex.InParam) -> DCol:
+        ctx = _active_params()
+        if ctx is None:
+            raise Unsupported(f"unbound parameter P{e.slot}",
+                              code="NDS201")
+        c = self.eval(e.operand)
+        if c.ctype.kind == "string":
+            if c.dictionary is None:
+                raise Unsupported("IN parameter on non-dictionary "
+                                  "string", code="NDS206")
+            table = ctx.str_table(e.slot, "in", False, c.dictionary)
+            data = table[c.data]
+        else:
+            data = jnp.isin(c.data, ctx.num_vec(e.slot, c.ctype))
+        if e.negated:
+            # the canonicalizer only lifts NULL-free IN-lists, so plain
+            # complement is exact (no three-valued NOT IN hazard)
+            data = ~data
         return DCol(data, c.valid, BOOL)
 
     def _concat_pair(self, a: DCol, b: DCol) -> DCol:
@@ -1558,6 +1787,9 @@ class JaxExecutor:
         if isinstance(e, ex.InList):
             return ex.InList(self._resolve_subqueries(e.operand), e.values,
                              e.negated)
+        if isinstance(e, ex.InParam):
+            return ex.InParam(self._resolve_subqueries(e.operand), e.slot,
+                              e.n, e.negated)
         return e
 
     # -- leaves --------------------------------------------------------------
@@ -1794,7 +2026,7 @@ class JaxExecutor:
                           for c, v in node.whens),
                     rebuild(node.default)
                     if node.default is not None else None)
-            if isinstance(node, ex.Literal):
+            if isinstance(node, (ex.Literal, ex.Param)):
                 return node
             raise Unsupported(
                 f"grouping-sets rewrite: {type(node).__name__}")
@@ -2005,7 +2237,8 @@ class JaxExecutor:
             active = subset is None or idx in subset
             return DCol(jnp.full(ngseg, 0 if active else 1, jnp.int32),
                         jnp.ones(ngseg, bool), INT32)
-        if isinstance(e, (ex.BinOp, ex.Cast, ex.Func, ex.Case, ex.Literal)):
+        if isinstance(e, (ex.BinOp, ex.Cast, ex.Func, ex.Case, ex.Literal,
+                          ex.Param)):
             # expression over aggregates: evaluate leaves then combine on
             # the group-capacity table
             sub_cols: Dict[str, DCol] = {}
@@ -3040,6 +3273,13 @@ class _CompiledPlan:
     # "NDSxxx:NodeName" tags for every fallback hit during discovery
     # (empty when compilable) — the static analyzer's prediction target
     fallback_codes: tuple = ()
+    # parameter materializations recorded during discovery (pdict hit
+    # tables / pvec IN vectors, in traversal order) — drives the
+    # "\x00params" replay-argument subtree for any later binding
+    param_spec: list = None
+    # representative SQL text for persisted records: canonical cache
+    # keys are not re-plannable, so save/load round-trips through SQL
+    source_sql: Optional[str] = None
 
 
 def _scan_columns(p: lp.Plan) -> Dict[str, Optional[List[str]]]:
@@ -3151,18 +3391,30 @@ class CompilingExecutor(JaxExecutor):
             "NDSTPU_ATTRIB", "0") not in ("", "0")
         self.last_attribution: Optional[dict] = None
 
-    def execute_cached(self, p: lp.Plan, key: str) -> Table:
+    def execute_cached(self, p: lp.Plan, key: str,
+                       params: Optional[ex.ParamBinding] = None,
+                       sql: Optional[str] = None) -> Table:
         # compile-once across concurrent streams: the key latch makes
-        # the first arrival for a text pay discovery while later
+        # the first arrival for a key pay discovery while later
         # arrivals block, then take the cache-hit replay path; the
         # exec lock serializes the actual device execution (see
         # JaxExecutor.__init__).  A failed discovery caches nothing
         # and releases the latch, so it cannot poison other streams.
+        # Under canonical keying (analysis/canon.py) `key` is the plan's
+        # structural fingerprint, `p` the parameterized exec plan, and
+        # `params` the binding for THIS rendering — streams rendering
+        # different literals for one template share the compiled entry.
         with self._key_latch.holding(key):
             with self._exec_lock:
-                return self._execute_cached_locked(p, key)
+                ctx = _ParamCtx(params.values, "concrete") \
+                    if params is not None else None
+                with _params_bound(ctx):
+                    return self._execute_cached_locked(p, key, params,
+                                                       sql)
 
-    def _execute_cached_locked(self, p: lp.Plan, key: str) -> Table:
+    def _execute_cached_locked(self, p: lp.Plan, key: str,
+                               params: Optional[ex.ParamBinding] = None,
+                               sql: Optional[str] = None) -> Table:
         versions = tuple(sorted(
             getattr(self.catalog, "versions", {}).items()))
         cp = self._compiled.get(key)
@@ -3172,12 +3424,13 @@ class CompilingExecutor(JaxExecutor):
             from ndstpu import faults
             faults.check("compile", key=key)
             obs.inc("engine.cache.compiled.miss")
-            return self._discover_query(p, key, versions)
+            return self._discover_query(p, key, versions, params, sql)
         obs.inc("engine.cache.compiled.hit")
         if not cp.compilable:
-            result = self._eager_with_segments(cp)
+            result = self._eager_with_segments(cp, params)
             if result is None:   # a shared segment was evicted: rebuild
-                return self._forget_and_rediscover(p, key, versions)
+                return self._forget_and_rediscover(p, key, versions,
+                                                   params, sql)
             return result
         if cp.fn is None:
             # size-plan record preloaded from disk (see
@@ -3185,22 +3438,24 @@ class CompilingExecutor(JaxExecutor):
             try:
                 cp.fn = self._build_jit(cp)
             except Exception:
-                return self._forget_and_rediscover(p, key, versions)
+                return self._forget_and_rediscover(p, key, versions,
+                                                   params, sql)
         if cp.preloaded:
             # first execution of a disk-loaded record: ANY failure —
             # arg build, compile, execution, or result assembly against
             # stale out_meta — means the record drifted; rediscover
             try:
-                result = self._replay_query(cp)
+                result = self._replay_query(cp, binding=params)
             except Exception:
                 result = None
             if result is None:
-                return self._forget_and_rediscover(p, key, versions)
+                return self._forget_and_rediscover(p, key, versions,
+                                                   params, sql)
             cp.preloaded = False
             cp.fn_validated = True
             return result
         try:
-            result = self._replay_query(cp)
+            result = self._replay_query(cp, binding=params)
         except jax.errors.JaxRuntimeError as first_err:
             if cp.fn_validated:
                 raise  # a real device failure, not a compile rejection
@@ -3208,7 +3463,7 @@ class CompilingExecutor(JaxExecutor):
             # (preemption/OOM): retry once before permanently demoting
             # this query to the eager per-op path — slower, correct
             try:
-                result = self._replay_query(cp)
+                result = self._replay_query(cp, binding=params)
             except jax.errors.JaxRuntimeError:
                 import warnings
                 # warnings.warn (not print): the harness report layer
@@ -3222,13 +3477,15 @@ class CompilingExecutor(JaxExecutor):
                     stacklevel=2)
                 cp.compilable = False
                 cp.fn = None
-                return self._eager_with_segments(cp)
+                return self._eager_with_segments(cp, params)
         if result is None:  # size-class guard failed: data changed
-            return self._forget_and_rediscover(p, key, versions)
+            return self._forget_and_rediscover(p, key, versions,
+                                               params, sql)
         cp.fn_validated = True
         return result
 
-    def _forget_and_rediscover(self, p, key, versions) -> Table:
+    def _forget_and_rediscover(self, p, key, versions,
+                               params=None, sql=None) -> Table:
         import warnings
         warnings.warn(
             f"compiled plan invalidated (size-class guard failed or "
@@ -3238,12 +3495,13 @@ class CompilingExecutor(JaxExecutor):
         if cp is not None:
             for fp in (cp.seg_fps or ()):
                 self._seg_compiled.pop(fp, None)
-        return self._discover_query(p, key, versions)
+        return self._discover_query(p, key, versions, params, sql)
 
     # -- replay ---------------------------------------------------------------
 
-    def _replay_query(self, cp: _CompiledPlan,
-                      bucket: str = "execute_s") -> Optional[Table]:
+    def _replay_query(self, cp: _CompiledPlan, bucket: str = "execute_s",
+                      binding: Optional[ex.ParamBinding] = None,
+                      ) -> Optional[Table]:
         """Dispatch segment programs then the parent; ONE batched
         device->host fetch at the end (a fetch costs a tunnel round
         trip).  None = some size guard failed (data changed).
@@ -3257,11 +3515,12 @@ class CompilingExecutor(JaxExecutor):
         device pipeline."""
         with obs.span("replay", cat="plan-node", bucket=bucket,
                       n_programs=1 + len(cp.seg_fps or ())) as sp:
-            result = self._replay_query_timed(cp, sp)
+            result = self._replay_query_timed(cp, sp, binding)
         return result
 
-    def _replay_query_timed(self, cp: _CompiledPlan,
-                            sp) -> Optional[Table]:
+    def _replay_query_timed(self, cp: _CompiledPlan, sp,
+                            binding: Optional[ex.ParamBinding] = None,
+                            ) -> Optional[Table]:
         attrib = self.attrib_enabled
         t_start = time.perf_counter()
         seg_args = {}
@@ -3278,6 +3537,8 @@ class CompilingExecutor(JaxExecutor):
                     scp.fn = self._build_jit(scp)
                 args = {t: self._accel_args(t, c)
                         for t, c in scp.table_cols.items()}
+                args["\x00params"] = _param_args_np(scp.param_spec,
+                                                    binding)
                 if attrib:
                     seg_flop_args.append((scp, args))
                 (out, alive), ok = scp.fn(args)
@@ -3285,12 +3546,14 @@ class CompilingExecutor(JaxExecutor):
                 seg_oks.append(ok)
             else:
                 # fallback-isolated segment: host numpy result, shipped
-                # to the device at the recorded output capacity
+                # to the device at the recorded output capacity (the
+                # ambient concrete _ParamCtx supplies bound values)
                 host = self.execute_to_host(scp.plan)
                 seg_args[_seg_argname(fp)] = self._seg_host_args(
                     scp, host)
         args = {t: self._accel_args(t, cols)
                 for t, cols in cp.table_cols.items()}
+        args["\x00params"] = _param_args_np(cp.param_spec, binding)
         args.update(seg_args)
         t_dispatch = time.perf_counter()
         (out, alive), ok = cp.fn(args)
@@ -3368,15 +3631,21 @@ class CompilingExecutor(JaxExecutor):
                                 None if valid.all() else valid, dictionary)
         return Table(cols)
 
-    def _replay_one(self, scp: _CompiledPlan) -> Optional[Table]:
+    def _replay_one(self, scp: _CompiledPlan,
+                    binding: Optional[ex.ParamBinding] = None,
+                    ) -> Optional[Table]:
         """Replay a single segment program to a host Table (reuse path:
-        a second query part sharing an already-compiled segment)."""
+        a second query part sharing an already-compiled segment).  Under
+        canonical keying the segment's parameter slots are bound from
+        the CURRENT query's binding — fingerprint-identical subtrees
+        share the compiled program even when their literals differ."""
         if not scp.compilable:
             return self.execute_to_host(scp.plan)
         if scp.fn is None:
             scp.fn = self._build_jit(scp)
         args = {t: self._accel_args(t, c)
                 for t, c in scp.table_cols.items()}
+        args["\x00params"] = _param_args_np(scp.param_spec, binding)
         (out, alive), ok = scp.fn(args)
         (out, alive_np), okv = jax.device_get(((out, alive), ok))
         if not bool(okv):
@@ -3412,7 +3681,9 @@ class CompilingExecutor(JaxExecutor):
 
     # -- discovery ------------------------------------------------------------
 
-    def _discover_query(self, p: lp.Plan, key: str, versions) -> Table:
+    def _discover_query(self, p: lp.Plan, key: str, versions,
+                        params: Optional[ex.ParamBinding] = None,
+                        sql: Optional[str] = None) -> Table:
         # the whole first-ever pass — eager discovery, jit builds, and
         # the warm-up replay that pays the XLA compile — is cold-path
         # cost a steady-state run never pays: bucket it as compile_s so
@@ -3421,10 +3692,12 @@ class CompilingExecutor(JaxExecutor):
         with obs.span("discover_query", cat="plan-node",
                       bucket="compile_s", n_segments=0) as sp:
             obs.inc("engine.discoveries")
-            return self._discover_query_traced(p, key, versions, sp)
+            return self._discover_query_traced(p, key, versions, sp,
+                                               params, sql)
 
-    def _discover_query_traced(self, p: lp.Plan, key: str, versions,
-                               sp) -> Table:
+    def _discover_query_traced(self, p: lp.Plan, key: str, versions, sp,
+                               params: Optional[ex.ParamBinding] = None,
+                               sql: Optional[str] = None) -> Table:
         parent, segs = _cut_segments(p)
         sp.set(n_segments=len(segs))
         self._seg_tables = {}
@@ -3439,7 +3712,7 @@ class CompilingExecutor(JaxExecutor):
                 # already compiled for another query (part): replay it
                 # for values instead of re-running eager discovery
                 try:
-                    host = self._replay_one(scp)
+                    host = self._replay_one(scp, params)
                 except Exception:
                     host = None
                 if host is not None:
@@ -3448,14 +3721,17 @@ class CompilingExecutor(JaxExecutor):
                     scp.preloaded = False
                     scp.fn_validated = True
             if dt is None:
-                scp, dt = self._discover_plan(sub, versions)
+                scp, dt = self._discover_plan(sub, versions,
+                                              params=params)
                 self._seg_compiled[fp] = scp
             self._seg_tables[fp] = dt
         # the parent's jit closure captures segment metas, so seg_fps
         # MUST be set before the fn is built (build_fn=False + build
         # here), or replay KeyErrors on the segment argument names
-        cp, dtp = self._discover_plan(parent, versions, build_fn=False)
+        cp, dtp = self._discover_plan(parent, versions, build_fn=False,
+                                      params=params)
         cp.seg_fps = list(segs.keys())
+        cp.source_sql = sql
         if cp.compilable:
             try:
                 cp.fn = self._build_jit(cp)
@@ -3472,7 +3748,8 @@ class CompilingExecutor(JaxExecutor):
             try:
                 # the warm-up call pays the XLA compile inside fn():
                 # bucket it compile_s, not execute_s
-                if self._replay_query(cp, bucket="compile_s") is not None:
+                if self._replay_query(cp, bucket="compile_s",
+                                      binding=params) is not None:
                     cp.fn_validated = True
             except Exception as e:  # noqa: BLE001
                 import warnings
@@ -3487,7 +3764,8 @@ class CompilingExecutor(JaxExecutor):
             # buffers; keeping them past the query holds HBM for nothing
             self._seg_tables = {}
 
-    def _discover_plan(self, p: lp.Plan, versions, build_fn=True):
+    def _discover_plan(self, p: lp.Plan, versions, build_fn=True,
+                       params: Optional[ex.ParamBinding] = None):
         """Discover ONE program (parent or segment): eager host
         execution recording every data-dependent decision; returns
         (cp, compacted eager DTable)."""
@@ -3500,20 +3778,29 @@ class CompilingExecutor(JaxExecutor):
         self._rec = []
         self._used_fallback = False
         self._fallback_codes = []
+        # record parameter materializations (pdict/pvec) alongside the
+        # size plan so replay can rebuild the argument subtree for any
+        # later binding of the same canonical fingerprint
+        pspec: list = []
+        pctx = _ParamCtx(params.values, "concrete", spec=pspec,
+                         record=True) if params is not None else None
         try:
-            with host_compute():
-                dt = self.execute(p)
-                # compact to the result's own size class BEFORE output:
-                # replay fetches (or hands the parent) every output
-                # column at padded capacity, and results are usually far
-                # smaller than the fact capacity they ride in on.  The
-                # compaction capacity is one more recorded sync point,
-                # so replay stays static.
-                dt = self.compact(dt)
+            with _params_bound(pctx) if pctx is not None \
+                    else contextlib.nullcontext():
+                with host_compute():
+                    dt = self.execute(p)
+                    # compact to the result's own size class BEFORE
+                    # output: replay fetches (or hands the parent) every
+                    # output column at padded capacity, and results are
+                    # usually far smaller than the fact capacity they
+                    # ride in on.  The compaction capacity is one more
+                    # recorded sync point, so replay stays static.
+                    dt = self.compact(dt)
         finally:
             self.mode = "eager"
             self._in_discovery = False
         cp = _CompiledPlan(p, not self._used_fallback, self._rec, versions)
+        cp.param_spec = pspec
         cp.fallback_codes = tuple(sorted(self._fallback_codes))
         cp.table_cols = _scan_columns(p)
         cp.out_capacity = dt.capacity
@@ -3526,18 +3813,20 @@ class CompilingExecutor(JaxExecutor):
                 cp.compilable = False
         return cp, dt
 
-    def _eager_with_segments(self, cp: _CompiledPlan):
+    def _eager_with_segments(self, cp: _CompiledPlan,
+                             params: Optional[ex.ParamBinding] = None):
         """Non-compilable parent: numpy-interpreter execution over
         segment results (still compiled where possible).  None when a
         shared segment is missing or its guard failed — the caller
-        rediscovers."""
+        rediscovers.  The ambient concrete _ParamCtx (installed by
+        execute_cached) binds any parameter slots the interpreter hits."""
         self._seg_tables = {}
         for fp in (cp.seg_fps or ()):
             scp = self._seg_compiled.get(fp)
             if scp is None:
                 return None
             try:
-                host = self._replay_one(scp)
+                host = self._replay_one(scp, params)
             except Exception:
                 host = None
             if host is None:
@@ -3565,7 +3854,10 @@ class CompilingExecutor(JaxExecutor):
                            .sum()) & (2 ** 61 - 1)
         return (name, t.num_rows, chk)
 
-    _REC_FORMAT = 3   # bump when the pickle schema changes
+    _REC_FORMAT = 4   # bump when the pickle schema changes
+                      # (4: + per-program param_spec; keys round-trip
+                      # through representative SQL so canonical cache
+                      # keys can be rebuilt by re-canonicalizing)
 
     def save_compile_records(self, path: str) -> int:
         """Persist discovery size-plan records (NOT compiled code — XLA
@@ -3583,7 +3875,10 @@ class CompilingExecutor(JaxExecutor):
         for key, cp in self._compiled.items():
             if not (cp.compilable and cp.record is not None):
                 continue
-            sql = key.split("|", 1)[1] if "|" in key else key
+            # canonical keys are not re-plannable text: prefer the
+            # representative SQL captured at discovery
+            sql = cp.source_sql or (
+                key.split("|", 1)[1] if "|" in key else key)
             try:
                 fps = tuple(self._table_fingerprint(t)
                             for t in sorted(cp.table_cols or ()))
@@ -3604,10 +3899,10 @@ class CompilingExecutor(JaxExecutor):
                         break
                     segstore[fp] = (scp.record, sfps, scp.table_cols,
                                     scp.out_meta, scp.out_capacity,
-                                    scp.compilable)
+                                    scp.compilable, scp.param_spec)
             if ok:
                 data[sql] = (cp.record, fps, cp.table_cols, cp.out_meta,
-                             cp.seg_fps, cp.out_capacity)
+                             cp.seg_fps, cp.out_capacity, cp.param_spec)
         # MERGE with what's already on disk, then publish atomically:
         # a subset run (e.g. a 12-query validation pass) must never
         # truncate a full-corpus record file another process spent
@@ -3639,10 +3934,12 @@ class CompilingExecutor(JaxExecutor):
                              key_prefix: str = "0") -> int:
         """Preload size-plan records saved by save_compile_records.
         `plan_for_key(sql)` must return the optimized plan for the SQL
-        text (or None to skip).  Records whose table fingerprints no
-        longer match the catalog are dropped; drifted records self-heal
-        at first execution (the replay guard rediscovers).  Returns the
-        count loaded."""
+        text — or, under canonical keying, an ``(exec_plan, cache_key)``
+        pair so the record registers under the same canonical key a
+        fresh rendering will probe (or None to skip).  Records whose
+        table fingerprints no longer match the catalog are dropped;
+        drifted records self-heal at first execution (the replay guard
+        rediscovers).  Returns the count loaded."""
         import pickle
         with open(path, "rb") as f:
             data = pickle.load(f)
@@ -3671,13 +3968,17 @@ class CompilingExecutor(JaxExecutor):
         for sql, ent in data.items():
             if sql.startswith("\x00"):
                 continue
-            norm = normalize_sql_key(sql)
-            (record, fps, table_cols, out_meta, seg_fps, out_cap) = ent
+            (record, fps, table_cols, out_meta, seg_fps, out_cap,
+             pspec) = ent
             if not fingerprints_ok(fps):
                 continue
-            plan = plan_for_key(sql)
-            if plan is None:
+            res = plan_for_key(sql)
+            if res is None:
                 continue
+            if isinstance(res, tuple):
+                plan, ckey = res   # canonical keying
+            else:
+                plan, ckey = res, normalize_sql_key(sql)
             parent, segs = _cut_segments(plan)
             if sorted(segs.keys()) != sorted(seg_fps or ()):
                 continue  # cut heuristic or plan changed: rediscover
@@ -3690,10 +3991,11 @@ class CompilingExecutor(JaxExecutor):
                 if sent is None or not fingerprints_ok(sent[1]):
                     seg_ok = False
                     break
-                (srec, _sfps, stc, som, socap, scomp) = sent
+                (srec, _sfps, stc, som, socap, scomp, spspec) = sent
                 scp = _CompiledPlan(segs[fp], scomp, srec, versions_now,
                                     stc, None, som, preloaded=True)
                 scp.out_capacity = socap
+                scp.param_spec = spspec
                 self._seg_compiled[fp] = scp
             if not seg_ok:
                 continue
@@ -3701,7 +4003,9 @@ class CompilingExecutor(JaxExecutor):
                                table_cols, None, out_meta, preloaded=True)
             cp.seg_fps = list(seg_fps or ())
             cp.out_capacity = out_cap
-            self._compiled[f"{key_prefix}|{norm}"] = cp
+            cp.param_spec = pspec
+            cp.source_sql = sql
+            self._compiled[f"{key_prefix}|{ckey}"] = cp
             n += 1
         return n
 
@@ -3776,16 +4080,25 @@ class CompilingExecutor(JaxExecutor):
             self._oks = []
             self._rec = cp.record
             self._trace_tables = {}
-            for name, (cols, alive) in tables.items():
+            for name, entry in tables.items():
+                if name == "\x00params":
+                    continue   # parameter subtree, not a table
+                cols, alive = entry
                 # iterate in META order, not arg order: jax pytrees sort
                 # dict keys, and column ORDER must match what discovery
                 # saw (SubqueryAlias zips aliases positionally)
                 dcols = {n: DCol(*cols[n], *metas[name][n])
                          for n in metas[name] if n in cols}
                 self._trace_tables[name] = DTable(dcols, alive)
+            pctx = _ParamCtx(None, "trace", spec=cp.param_spec or [],
+                             traced=tables.get("\x00params") or {})
             try:
-                dt = self.execute(cp.plan)
-                dt = self.compact(dt)   # mirror of _discover_plan
+                with _params_bound(pctx):
+                    dt = self.execute(cp.plan)
+                    dt = self.compact(dt)   # mirror of _discover_plan
+                if pctx.pos != len(pctx.spec):
+                    raise RuntimeError(
+                        "param-spec drift (unconsumed entries)")
                 # output-type guard: engine typing changes (e.g. the
                 # r04 coalesce decimal-literal fix) can retype a
                 # column without changing the PLAN tree, so a

@@ -453,6 +453,11 @@ class Executor:
             return ex.Evaluator(Table(cols)).eval(ex.Case(whens, default))
         if isinstance(e, ex.Literal):
             return ex.literal_column(e.value, ngroups, e.ctype)
+        if isinstance(e, ex.Param):
+            vals = ex.active_params()
+            if vals is None or e.shape:
+                raise NotImplementedError(f"unbound parameter S{e.slot}")
+            return ex.literal_column(vals[e.slot], ngroups, e.ctype)
         raise NotImplementedError(f"aggregate output expr {e}")
 
     def _agg_column(self, t: Table, a: ex.AggExpr, gids, ngroups,

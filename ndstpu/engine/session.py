@@ -107,18 +107,28 @@ class Session:
                     key: Optional[str] = None
                     ) -> Optional[columnar.Table]:
         if isinstance(stmt, ast.Query):
-            plan, disp = self._plan_cached(stmt, key)
+            plan, disp, canon = self._plan_cached(stmt, key)
+            if canon is not None:
+                # canonical identity on the query span: sidecars and the
+                # run ledger can group renderings by structure
+                from ndstpu import obs
+                obs.annotate(canon_fp=canon.fingerprint,
+                             canon_key=canon.cache_key)
+                codes = sorted({d.code for d in canon.diagnostics})
+                if codes:
+                    obs.annotate(canon_codes=",".join(codes))
             # execution serialized (see __post_init__): the executor's
             # per-query mutable state is not safe under concurrent
             # statements, and one device runs programs serially anyway
             with self._exec_lock:
-                out = self._execute(plan, key=key)
+                out = self._execute(plan, key=key, canon=canon)
             return columnar.Table(dict(zip(disp, out.columns.values())))
         with self._exec_lock:
             return self._run_ddl(stmt)
 
     def _plan_cached(self, stmt: "ast.Query", key: Optional[str]):
-        """Plan + optimize with the text-keyed plan cache.
+        """Plan + optimize + canonicalize with the text-keyed plan
+        cache; returns ``(plan, display_names, CanonResult-or-None)``.
 
         A steady-state replay of a compiled query must not re-plan +
         re-optimize the SQL every call (50-150 ms of pure host overhead
@@ -142,7 +152,7 @@ class Session:
         if key is None:
             with obs.span("plan", cat="plan-node"):
                 plan, disp = self._plan_fresh(stmt)
-            return plan, disp
+            return plan, disp, None
         latch = getattr(self, "_plan_latch", None)
         with (latch.holding(key) if latch is not None else _NULL_CM):
             versions = tuple(sorted(
@@ -155,16 +165,35 @@ class Session:
             obs.inc("engine.cache.plan.hit" if ent is not None
                     else "engine.cache.plan.miss")
             if ent is not None:
-                _s, plan, disp = ent
-                return plan, disp
+                _s, plan, disp, canon = ent
+                return plan, disp, canon
             with obs.span("plan", cat="plan-node"):
                 plan, disp = self._plan_fresh(stmt)
+            canon = self._canonicalize(plan, key)
             # store only on success: a planner exception propagates
             # with nothing cached (no poisoning), the latch releases
             # in its finally, and the next arrival retries
             with getattr(self, "_cache_lock", _NULL_CM):
-                pc[key] = (state, plan, disp)
-            return plan, disp
+                pc[key] = (state, plan, disp, canon)
+            return plan, disp, canon
+
+    def _canonicalize(self, plan: lp.Plan, key: str):
+        """Parameter-lift an optimized plan (analysis/canon.py) for
+        shape-keyed compile caching.  None (→ text keying) on any
+        canonicalization failure or with NDSTPU_CANON=0 — the safety
+        valve keeps queries running when the analyzer is wrong."""
+        import os
+        if os.environ.get("NDSTPU_CANON", "1") in ("", "0"):
+            return None
+        from ndstpu import obs
+        try:
+            from ndstpu.analysis import canon as _canon
+            with obs.span("canonicalize", cat="plan-node"):
+                return _canon.canonicalize(plan, query=key)
+        except Exception as e:  # noqa: BLE001
+            obs.inc("engine.canon.errors")
+            obs.annotate(canon_error=f"{type(e).__name__}: {e}")
+            return None
 
     def _plan_fresh(self, stmt: "ast.Query"):
         planner = pl.Planner(self.catalog, dict(self.views))
@@ -215,8 +244,8 @@ class Session:
                 out.append(n)
         return out
 
-    def _execute(self, plan: lp.Plan,
-                 key: Optional[str] = None) -> columnar.Table:
+    def _execute(self, plan: lp.Plan, key: Optional[str] = None,
+                 canon=None) -> columnar.Table:
         from ndstpu import faults
         faults.check("execute", key=key)
         # single-chip out-of-core: when chunk_rows is set, the `tpu`
@@ -285,6 +314,16 @@ class Session:
         if self.backend in ("tpu", "tpu-spmd"):
             exe = self._jax_executor()
             if key is not None:
+                if canon is not None:
+                    # shape-keyed compile cache: the key is the plan's
+                    # canonical fingerprint (+ shape residual), the plan
+                    # is the parameterized exec plan, and this
+                    # rendering's literals travel as the binding —
+                    # every rendering of a template shares one compile
+                    return exe.execute_cached(
+                        canon.exec_plan,
+                        f"{self._views_epoch}|{canon.cache_key}",
+                        params=canon.binding, sql=key)
                 return exe.execute_cached(
                     plan, f"{self._views_epoch}|{key}")
             return exe.execute_to_host(plan)
@@ -328,15 +367,38 @@ class Session:
             self._mesh_cache = m
         return m
 
+    def canonical_key(self, text: str) -> str:
+        """Structure-first dedup key for a query text: the canonical
+        plan fingerprint + shape residual (analysis/canon.py) when
+        canonicalization succeeds, the normalized text otherwise.  Two
+        renderings of a template that differ only in runtime-bindable
+        literals map to the SAME key — in-flight dedup and compile
+        caches keyed on this collapse per-stream permutations."""
+        from ndstpu.engine.sql import normalize_sql_key
+        norm = normalize_sql_key(text)
+        try:
+            stmt = parse_statement(text)
+            if not isinstance(stmt, ast.Query):
+                return norm
+            _plan, _disp, canon = self._plan_cached(stmt, norm)
+        except Exception:  # noqa: BLE001 — unparseable/unplannable text
+            return norm
+        return canon.cache_key if canon is not None else norm
+
     def compiled_plan(self, text: str):
         """The cached whole-query compile record for a SQL text (or None).
-        Test/introspection hook — mirrors the key used by `_execute`."""
+        Test/introspection hook — mirrors the key used by `_execute`:
+        canonical fingerprint first, normalized text as fallback."""
         from ndstpu.engine.sql import normalize_sql_key
         exe = getattr(self, "_jax_exec_cache", None)
         if exe is None:
             return None
-        return exe._compiled.get(
-            f"{self._views_epoch}|{normalize_sql_key(text)}")
+        cp = exe._compiled.get(
+            f"{self._views_epoch}|{self.canonical_key(text)}")
+        if cp is None:
+            cp = exe._compiled.get(
+                f"{self._views_epoch}|{normalize_sql_key(text)}")
+        return cp
 
     def save_compiled(self, path: str) -> int:
         """Persist whole-query size-plan records for the jax backend."""
@@ -345,12 +407,22 @@ class Session:
     def preload_compiled(self, path: str) -> int:
         """Preload size-plan records: later sql() calls skip discovery
         and go straight to the jitted replay (warm XLA cache makes the
-        first execution ~compile-free too)."""
+        first execution ~compile-free too).  Records re-canonicalize on
+        load so they register under the same canonical key a fresh
+        rendering will probe — a discover-process and a preload-process
+        agree on cache identity by construction."""
         def plan_for_sql(sql):
+            from ndstpu.engine.sql import normalize_sql_key
             try:
-                plan, _cols = self.plan(sql)
-            except Exception:
+                stmt = parse_statement(sql)
+                if not isinstance(stmt, ast.Query):
+                    return None
+                plan, _disp, canon = self._plan_cached(
+                    stmt, normalize_sql_key(sql))
+            except Exception:  # noqa: BLE001
                 return None
+            if canon is not None:
+                return canon.exec_plan, canon.cache_key
             return plan
 
         import os

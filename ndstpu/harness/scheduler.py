@@ -121,8 +121,16 @@ class StreamScheduler:
                                              Optional[float]]] = None,
                  phase: str = "throughput",
                  default_cost_s: float = progress.DEFAULT_COST_S,
-                 on_event: Callable[[str], None] = print):
+                 on_event: Callable[[str], None] = print,
+                 key_fn: Optional[Callable[[str], str]] = None):
+        # key_fn maps SQL text -> dedup key.  Default: normalized text.
+        # The inproc runner passes Session.canonical_key so streams
+        # whose renderings differ only in bindable literals share one
+        # compile entry — with text keys each stream's fresh RNG values
+        # looked "cold" and the cheapest-cold-first pick order re-paid
+        # every compile per stream.
         from ndstpu.engine.sql import normalize_sql_key
+        kf = key_fn or normalize_sql_key
         self._lock = threading.RLock()
         self.budget_s = budget_s if budget_s and budget_s > 0 else None
         self.phase = phase
@@ -130,13 +138,13 @@ class StreamScheduler:
         self._est_cold = est_cold
         self._est_warm = est_warm
         self._on_event = on_event
-        self.compiled: set = set()    # normalized texts known compiled
-        self.inflight: Dict[str, str] = {}  # text -> stream building it
+        self.compiled: set = set()    # dedup keys known compiled
+        self.inflight: Dict[str, str] = {}  # key -> stream building it
         self._key: Dict[tuple, str] = {}
         self._views: "OrderedDict[str, _StreamView]" = OrderedDict()
         for sid, qd in stream_queries.items():
             for name, sql in qd.items():
-                self._key[(sid, name)] = normalize_sql_key(sql)
+                self._key[(sid, name)] = kf(sql)
             self._views[sid] = _StreamView(self, sid, list(qd))
 
     def view(self, sid: str) -> _StreamView:
@@ -367,7 +375,8 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
         warmth="warm")
     sched = StreamScheduler(
         {sid: dict(qd) for sid, qd in stream_queries.items()},
-        budget_s=budget_s, est_cold=est_cold, est_warm=est_warm)
+        budget_s=budget_s, est_cold=est_cold, est_warm=est_warm,
+        key_fn=session.canonical_key)
 
     slots = concurrent if concurrent else 1
     gate = adm.InprocAdmission(slots)

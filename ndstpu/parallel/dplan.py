@@ -73,6 +73,26 @@ class DistUnsupported(Exception):
         self.code = code
 
 
+def _has_params(plan: lp.Plan) -> bool:
+    """True when any expression in the plan carries a parameter slot.
+    The session only hands dplan original (literal-bearing) plans — the
+    canonical exec_plan stays on the single-chip cache path — so this
+    guard exists to fail loud instead of tracing a Param into shard_map
+    if that invariant is ever broken upstream."""
+    for node in plan.walk():
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            items = v if isinstance(v, (list, tuple)) else (v,)
+            for it in items:
+                if isinstance(it, tuple):  # sort keys: (expr, asc[, nf])
+                    it = it[0] if it else None
+                if isinstance(it, ex.Expr) and any(
+                        isinstance(x, (ex.Param, ex.InParam))
+                        for x in it.walk()):
+                    return True
+    return False
+
+
 _SPINE_NODES = (lp.Scan, lp.Filter, lp.Project, lp.Join, lp.SubqueryAlias)
 # shardable key kinds and decomposable aggregates come from the shared
 # supported-op registry so the static analyzer (NDS3xx) cannot drift
@@ -179,6 +199,9 @@ class DistributedPlanExecutor:
         """Try candidate fact tables largest-first (at tiny scale factors
         a fixed-size dimension like date_dim can out-size the fact, and
         some spines fail preparation, e.g. non-unique build keys)."""
+        if _has_params(plan):
+            raise DistUnsupported(
+                "parameterized (canonical) plan on spmd path", code="NDS301")
         union = self._try_union_agg(plan)
         if union is not None:
             return union

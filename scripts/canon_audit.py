@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Corpus-wide canonicalization audit.
+
+Renders the power corpus under several RNGSEED x stream combinations —
+each combo substitutes different literals into the same 99 templates —
+canonicalizes every part's optimized plan (zero-row schema catalog, no
+warehouse, no jax), and checks that each part **collapses**: every
+rendering maps to ONE canonical cache key, i.e. one compiled XLA program
+would serve all probed permutations with literals bound at runtime.
+
+Emits:
+
+* ``CANON_AUDIT.json`` / ``CANON_AUDIT.md`` (repo root): per-part
+  fingerprint/cache-key sets, slot counts, and the collapse verdict.
+  Deterministic (no timestamps) so committed copies only change when the
+  plans or the canonicalizer change.
+* ``NDS404`` diagnostics for parts that fail to collapse.  With
+  ``--baseline [PATH]``: exit nonzero iff a diagnostic is NOT in the
+  committed baseline (docs/canon_audit_baseline.json).
+* With ``--write-baseline``: regenerate the baseline from this sweep.
+
+Usage:
+    python scripts/canon_audit.py                      # artifacts only
+    python scripts/canon_audit.py --baseline           # CI gate
+    python scripts/canon_audit.py --write-baseline     # accept current set
+"""
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / "docs" / "canon_audit_baseline.json"
+# the pinned bench seed plus one fresh seed; two streams each — four
+# renderings per part, every literal choice re-drawn
+DEFAULT_RNGSEEDS = "07291122510,19980713042"
+DEFAULT_STREAMS = "0,1"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                    default=None, metavar="PATH",
+                    help="gate against this baseline (default: "
+                         "docs/canon_audit_baseline.json); exit 1 on new "
+                         "diagnostics")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from this sweep")
+    ap.add_argument("--json", default=str(REPO / "CANON_AUDIT.json"))
+    ap.add_argument("--md", default=str(REPO / "CANON_AUDIT.md"))
+    ap.add_argument("--rngseeds", default=DEFAULT_RNGSEEDS,
+                    help="comma-separated stream seeds to probe")
+    ap.add_argument("--streams", default=DEFAULT_STREAMS,
+                    help="comma-separated stream numbers to probe")
+    ap.add_argument("--sub_queries", default=None,
+                    help="comma-separated query-part subset (CI tiny run)")
+    return ap
+
+
+def sweep(args):
+    """part -> {combo: (cache_key, fingerprint, n_bind, n_shape)} plus
+    per-part canonicalization errors (part -> message)."""
+    from ndstpu import analysis
+    from ndstpu.engine.session import Session
+    from ndstpu.queries import streamgen
+
+    sess = Session(analysis.schema_catalog())
+    tables = analysis.schema_tables()
+    subset = set(args.sub_queries.split(",")) if args.sub_queries else None
+    seeds = [s.strip() for s in args.rngseeds.split(",") if s.strip()]
+    streams = [int(s) for s in args.streams.split(",") if s.strip()]
+
+    per_part, errors = {}, {}
+    for seed in seeds:
+        for stream in streams:
+            combo = f"seed={seed}/stream={stream}"
+            for name, sql in streamgen.render_power_corpus(
+                    rngseed=seed, stream=stream):
+                if subset is not None and name not in subset:
+                    continue
+                try:
+                    plan, _cols = sess.plan(sql)
+                    res = analysis.canonicalize(plan, tables=tables,
+                                                query=name)
+                except Exception as e:
+                    errors[name] = f"{combo}: {type(e).__name__}: {e}"
+                    continue
+                per_part.setdefault(name, {})[combo] = (
+                    res.cache_key, res.fingerprint,
+                    len(res.bindable), len(res.shape_affecting))
+    return per_part, errors, seeds, streams
+
+
+def run_audit(args) -> int:
+    from ndstpu.analysis import diagnostics as diag_mod
+
+    per_part, errors, seeds, streams = sweep(args)
+    n_combos = len(seeds) * len(streams)
+
+    # A part COLLAPSES when every probed rendering maps to one canonical
+    # fingerprint — one compiled structure serves all of them.  Shape-
+    # affecting residue (varying cache keys on one fingerprint) is
+    # reported but is not a failure: those slots carry their own NDS401/
+    # 402/403 diagnostics in the plan-lint baseline.
+    parts, diags = {}, []
+    for name in sorted(set(per_part) | set(errors)):
+        combos = per_part.get(name, {})
+        keys = sorted({k for k, _, _, _ in combos.values()})
+        fps = sorted({f for _, f, _, _ in combos.values()})
+        collapsed = (len(fps) == 1 and name not in errors
+                     and len(combos) == n_combos)
+        parts[name] = {
+            "collapsed": collapsed,
+            "one_program": collapsed and len(keys) == 1,
+            "cache_keys": keys,
+            "fingerprints": fps,
+            "bindable": max((b for _, _, b, _ in combos.values()),
+                            default=0),
+            "shape": max((s for _, _, _, s in combos.values()),
+                         default=0),
+        }
+        if name in errors:
+            parts[name]["error"] = errors[name]
+        if not collapsed:
+            why = (errors.get(name) or
+                   f"{len(fps)} distinct fingerprints over "
+                   f"{len(combos)} renderings")
+            diags.append(diag_mod.Diagnostic(
+                code="NDS404", query=name, path="corpus",
+                message=why))
+
+    n_collapsed = sum(1 for p in parts.values() if p["collapsed"])
+    meta = {
+        "rngseeds": seeds,
+        "streams": streams,
+        "combos": n_combos,
+        "parts": len(parts),
+        "collapsed": n_collapsed,
+        "one_program": sum(1 for p in parts.values()
+                           if p["one_program"]),
+        "failed": sorted(n for n, p in parts.items()
+                         if not p["collapsed"]),
+    }
+
+    import json
+    doc = {"meta": meta, "parts": parts,
+           "diagnostics": [d.as_dict()
+                           for d in diag_mod.sort_diagnostics(diags)]}
+    pathlib.Path(args.json).write_text(
+        json.dumps(doc, indent=2, sort_keys=True) + "\n")
+
+    lines = ["# Canonicalization audit", ""]
+    for k, v in sorted(meta.items()):
+        lines.append(f"- **{k}**: {v}")
+    lines += ["",
+              f"{n_collapsed}/{len(parts)} parts collapse to a single "
+              "canonical fingerprint across all probed renderings "
+              f"({meta['one_program']} of them to a single cache key, "
+              "i.e. no shape-affecting residue varies).", "",
+              "| part | collapsed | fingerprints | cache keys "
+              "| bindable | shape |",
+              "|---|---|---|---|---|---|"]
+    for name, p in sorted(parts.items()):
+        mark = "yes" if p["collapsed"] else "**NO**"
+        lines.append(f"| {name} | {mark} | {len(p['fingerprints'])} "
+                     f"| {len(p['cache_keys'])} | {p['bindable']} "
+                     f"| {p['shape']} |")
+    if diags:
+        lines += ["", "## Failures", ""]
+        for d in diag_mod.sort_diagnostics(diags):
+            lines.append(f"- `{d.query}` {d.code}: {d.message}")
+    pathlib.Path(args.md).write_text("\n".join(lines) + "\n")
+
+    print(f"canon-audit: {len(parts)} parts, {n_collapsed} collapsed, "
+          f"{len(diags)} failure(s) over {n_combos} renderings "
+          f"-> {args.json}")
+
+    if args.write_baseline:
+        DEFAULT_BASELINE.write_text(diag_mod.baseline_dump(diags))
+        print(f"canon-audit: baseline rewritten -> {DEFAULT_BASELINE}")
+
+    if args.baseline is not None:
+        bpath = pathlib.Path(args.baseline)
+        if not bpath.exists():
+            print(f"canon-audit: baseline {bpath} missing "
+                  "(run --write-baseline)", file=sys.stderr)
+            return 2
+        accepted = diag_mod.baseline_load(bpath.read_text())
+        new = diag_mod.new_against_baseline(diags, accepted)
+        if new:
+            print(f"canon-audit: {len(new)} part(s) regressed vs "
+                  "baseline:", file=sys.stderr)
+            for d in new:
+                print(f"  {d.query} {d.code}: {d.message}",
+                      file=sys.stderr)
+            return 1
+        print(f"canon-audit: clean against baseline "
+              f"({len(accepted)} accepted)")
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_audit(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

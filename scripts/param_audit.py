@@ -9,8 +9,13 @@ historical query10 county-list bug).
 
 For each template/stream and each `dist(...)`/`distlist(u)` parameter:
 
-* locate the column the parameter predicates on (from the template
-  body: `s_state = '[STATE]'` -> store.s_state),
+* locate the column the parameter predicates on from the CANONICALIZER's
+  slot->column bindings (ndstpu/analysis/canon.py): the template is
+  rendered once, each part's optimized plan is canonicalized over the
+  zero-row schema catalog, and the drawn value is matched to the slot
+  that carries it — so attribution comes from the plan the engine
+  actually runs, not from a hand-maintained substring table that could
+  drift from the templates,
 * check the drawn value against the generated warehouse column,
 * aggregate per (template, param): hit-rate over streams and the
   weight MASS of the distribution present in the data.
@@ -40,38 +45,39 @@ from ndstpu import schema  # noqa: E402
 from ndstpu.check import check_build  # noqa: E402
 from ndstpu.queries import streamgen  # noqa: E402
 
-# column substring -> (table, column) the audit reads; ordered so the
-# conditioned store_* columns win (mirror of the template-sweep rules)
-COLUMNS = [
-    ("s_gmt_offset", ("store", "s_gmt_offset")),
-    ("ca_gmt_offset", ("customer_address", "ca_gmt_offset")),
-    ("s_county", ("store", "s_county")),
-    ("cc_county", ("call_center", "cc_county")),
-    ("ca_county", ("customer_address", "ca_county")),
-    ("s_state", ("store", "s_state")),
-    ("ca_state", ("customer_address", "ca_state")),
-    ("w_state", ("warehouse", "w_state")),
-    ("s_city", ("store", "s_city")),
-    ("ca_city", ("customer_address", "ca_city")),
-    ("i_category", ("item", "i_category")),
-    ("i_class", ("item", "i_class")),
-    ("i_color", ("item", "i_color")),
-    ("cd_marital_status", ("customer_demographics", "cd_marital_status")),
-    ("cd_education_status", ("customer_demographics",
-                             "cd_education_status")),
-    ("cd_gender", ("customer_demographics", "cd_gender")),
-    ("hd_buy_potential", ("household_demographics", "hd_buy_potential")),
-    ("sm_carrier", ("ship_mode", "sm_carrier")),
-    ("r_reason_desc", ("reason", "r_reason_desc")),
-]
+_DIST_KINDS = ("dist", "distlist", "distlistu")
 
-DIM_TABLES = sorted({t for _, (t, _) in COLUMNS})
+# lazy singletons: the schema-catalog planner session + schema tables
+# the canonicalizer attributes slots against (no data, no jax)
+_ANALYSIS_CTX = None
 
 
-def gen_dims(out_dir: Path, sf: float) -> None:
+def _analysis_ctx():
+    global _ANALYSIS_CTX
+    if _ANALYSIS_CTX is None:
+        from ndstpu import analysis
+        from ndstpu.engine.session import Session
+        _ANALYSIS_CTX = (Session(analysis.schema_catalog()),
+                         analysis.schema_tables())
+    return _ANALYSIS_CTX
+
+
+def dim_tables(template_dir=None) -> list:
+    """Dimension tables any dist-drawn parameter predicates, from the
+    canonicalizer's attributions (replaces the old hand-rolled list)."""
+    d = Path(template_dir) if template_dir else streamgen.TEMPLATE_DIR
+    tabs = set()
+    for tpl in streamgen.list_templates(template_dir):
+        for target, _dname in template_param_columns(d / tpl).values():
+            if target is not None:
+                tabs.add(target[0])
+    return sorted(tabs)
+
+
+def gen_dims(out_dir: Path, sf: float, template_dir=None) -> None:
     tool = check_build()
     out_dir.mkdir(parents=True, exist_ok=True)
-    for t in DIM_TABLES:
+    for t in dim_tables(template_dir):
         subprocess.run([str(tool), "-scale", str(sf), "-dir", str(out_dir),
                         "-table", t], check=True)
 
@@ -98,27 +104,80 @@ def norm(v: str) -> str:
         return v
 
 
-def template_param_columns(tpl_path: Path):
-    """{param: (table, column)} for dist-drawn params, located from the
-    body line(s) the parameter appears in."""
-    text = tpl_path.read_text()
-    params, body = streamgen._parse_template(text)
+_TPC_CACHE: dict = {}
+
+
+def template_param_columns(tpl_path: Path, rngseed: str = "0",
+                           streams: int = 4):
+    """{param: ((table, column) | None, distname)} for dist-drawn params,
+    attributed through the canonicalizer: render the template over a few
+    probe streams, lift every literal of every part's optimized plan into
+    slots, and match each drawn value to the slot(s) carrying it — the
+    slot's source column is the column the engine actually filters on.
+    Candidate columns are INTERSECTED across probe streams so value
+    collisions ('M' is both a gender and a marital status) resolve as
+    soon as one stream draws a value unique to the real column."""
+    from ndstpu.analysis import canon
+
+    ck = (str(tpl_path), rngseed, streams)
+    if ck in _TPC_CACHE:
+        return _TPC_CACHE[ck]
+    params, _body = streamgen._parse_template(tpl_path.read_text())
+    dists = {name: vals[0] for name, (kind, vals) in params.items()
+             if kind in _DIST_KINDS}
+    if not dists:
+        _TPC_CACHE[ck] = {}
+        return {}
+    sess, tables = _analysis_ctx()
+    cand: dict = {name: None for name in dists}  # running intersection
+    for stream in range(streams):
+        exact: dict = {}   # norm(value) -> {(table, column)}
+        raw: dict = {}     # str(value)  -> {(table, column)} (LIKE etc.)
+        for pname, sql in streamgen.render_template_parts(
+                str(tpl_path), rngseed, stream):
+            plan, _cols = sess.plan(sql)
+            res = canon.canonicalize(plan, tables=tables, query=pname)
+            for s in res.slots:
+                if s.column is None:
+                    continue
+                vals = s.value if isinstance(s.value, tuple) \
+                    else (s.value,)
+                for v in vals:
+                    exact.setdefault(norm(str(v)), set()).add(s.column)
+                    if isinstance(v, str):
+                        raw.setdefault(v, set()).add(s.column)
+        drawn = streamgen.render_params(str(tpl_path), rngseed, stream)
+        for name in dists:
+            dv = drawn.get(name)
+            cols: set = set()
+            for v in (dv if isinstance(dv, list) else [dv]):
+                cols |= exact.get(norm(str(v)), set())
+                if isinstance(v, str) and v:
+                    # templates may decorate the drawn value with LIKE
+                    # wildcards ('[BP]%' -> LIKE '0-500%'); match those —
+                    # alongside exact hits, so a coincidental exact
+                    # collision ('Unknown' is also an education level)
+                    # still intersects away across streams
+                    for lit, cset in raw.items():
+                        rest = lit[len(v):]
+                        if lit.startswith(v) and rest and \
+                                all(ch in "%_" for ch in rest):
+                            cols |= cset
+            if not cols:
+                continue
+            inter = cols if cand[name] is None else cand[name] & cols
+            cand[name] = inter or cand[name] | cols
     out = {}
-    for name, (kind, vals) in params.items():
-        if kind not in ("dist", "distlist", "distlistu"):
-            continue
-        hits = []
-        for ln in body.splitlines():
-            if f"[{name}]" in ln or f"[{name}." in ln:
-                for col, target in COLUMNS:
-                    if col in ln:
-                        hits.append(target)
-        if hits:
+    for name, dname in dists.items():
+        cols = cand[name]
+        if cols:
             # conditioned store columns first (same rule as the sweep)
-            hits.sort(key=lambda t: 0 if t[0] == "store" else 1)
-            out[name] = (hits[0], vals[0])
+            target = sorted(
+                cols, key=lambda t: (0 if t[0] == "store" else 1, t))[0]
+            out[name] = (target, dname)
         else:
-            out[name] = (None, vals[0])
+            out[name] = (None, dname)
+    _TPC_CACHE[ck] = out
     return out
 
 
@@ -143,7 +202,8 @@ def run_audit(data_dir: Path, rngseed: str, streams: int,
             if target is None:
                 report["failures"].append(
                     {"template": tpl, "param": name, "dist": dname,
-                     "error": "no target column found in template body"})
+                     "error": "no predicating column found in the "
+                              "canonicalized plans"})
                 continue
             table, column = target
             data_vals = values_for(table, column)

@@ -62,7 +62,7 @@ def run_lint(args) -> int:
     tables = analysis.schema_tables()
     subset = set(args.sub_queries.split(",")) if args.sub_queries else None
 
-    diags, verdicts = [], {}
+    diags, verdicts, fps = [], {}, {}
     for name, sql in streamgen.render_power_corpus(
             rngseed=args.rngseed, stream=args.stream):
         if subset is not None and name not in subset:
@@ -71,6 +71,10 @@ def run_lint(args) -> int:
                                    scale_factor=args.scale_factor)
         verdicts[name] = res.verdict
         diags.extend(res.diagnostics)
+        if res.canon is not None:
+            fps[name] = {"fingerprint": res.canon.fingerprint,
+                         "bindable": len(res.canon.bindable),
+                         "shape": len(res.canon.shape_affecting)}
 
     meta = {
         "rngseed": args.rngseed,
@@ -81,8 +85,17 @@ def run_lint(args) -> int:
         "fallback": sorted(q for q, v in verdicts.items()
                            if v == "fallback"),
     }
-    pathlib.Path(args.json).write_text(diag_mod.to_json(diags, meta))
-    pathlib.Path(args.md).write_text(diag_mod.to_markdown(diags, meta))
+    pathlib.Path(args.json).write_text(
+        diag_mod.to_json(diags, dict(meta, canon_fingerprints=fps)))
+    md = diag_mod.to_markdown(diags, meta)
+    if fps:
+        md += ("\n## Canonical fingerprints\n\n"
+               "| part | fingerprint | bindable slots | shape slots |\n"
+               "|---|---|---|---|\n")
+        md += "".join(
+            f"| {q} | `{e['fingerprint']}` | {e['bindable']} "
+            f"| {e['shape']} |\n" for q, e in sorted(fps.items()))
+    pathlib.Path(args.md).write_text(md)
     print(f"plan-lint: {meta['parts']} parts, {meta['device']} device, "
           f"{len(meta['fallback'])} fallback, {len(diags)} diagnostics "
           f"-> {args.json}")

@@ -45,6 +45,15 @@ def test_plan_lint_root_citations_checked(tmp_path):
     assert artifact_lint.lint_text(text, str(tmp_path)) == []
 
 
+def test_canon_audit_root_citations_checked(tmp_path):
+    text = "collapse sweep in `CANON_AUDIT.json` and `CANON_AUDIT.md`\n"
+    findings = artifact_lint.lint_text(text, str(tmp_path))
+    assert len(findings) == 2
+    (tmp_path / "CANON_AUDIT.json").write_text("{}")
+    (tmp_path / "CANON_AUDIT.md").write_text("# canon\n")
+    assert artifact_lint.lint_text(text, str(tmp_path)) == []
+
+
 def test_config_mismatch_flagged_unless_stale(tmp_path):
     docs = tmp_path / "docs"
     docs.mkdir()

@@ -157,7 +157,9 @@ def test_preloaded_record_drift_self_heals(catalog, tmp_path):
     s2 = _fresh_tpu_session(catalog)
     assert s2.preload_compiled(path) >= 1
     exe2 = s2._jax_executor()
-    cp = exe2._compiled.get(f"{s2._views_epoch}|{_SEG_SQL}")
+    # compiled_plan probes the canonical (fingerprint) key first, the
+    # normalized-text key as fallback — same lookup _execute performs
+    cp = s2.compiled_plan(_SEG_SQL)
     assert cp is not None and cp.preloaded
     # simulate drift: shrink every recorded capacity so the size-class
     # guards cannot hold at execution time
