@@ -65,6 +65,14 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NDS305": ("info", "predicted exchange placement (broadcast/shuffle)"),
     "NDS306": ("info", "row spine does no distributed work"),
     "NDS307": ("warning", "join key kind not shardable on the spine"),
+    "NDS308": ("info", "existence-join build side reduced to distinct "
+                       "key tuples distributed (no host build of the "
+                       "sharded table)"),
+    "NDS309": ("info", "aggregate distributes over a union-all of "
+                       "sharded branches (per-branch spines, host "
+                       "partial combine)"),
+    "NDS310": ("info", "row-spine tail (sort/limit/window) finalizes "
+                       "on-device; only the small result gathers"),
     # -- NDS4xx canonicalization / parameter lifting ----------------------
     "NDS401": ("info", "shape-affecting literal: value feeds static shape "
                        "or capacity planning (LIMIT, interval width, "

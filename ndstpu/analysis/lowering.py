@@ -134,6 +134,71 @@ SPMD_FACT_TABLES = frozenset({
     "web_sales", "web_returns", "inventory",
 })
 
+#: existence-join kinds whose sharded build side reduces to its distinct
+#: (key, residual-column) tuples via a child distributed aggregate
+#: before broadcasting (dplan._reduce_build — existence semantics are
+#: insensitive to duplicate build rows, so the reduction is lossless)
+SPMD_REDUCIBLE_BUILD_JOIN_KINDS = frozenset({
+    "semi", "anti", "nullaware_anti", "mark",
+})
+
+
+def spmd_window_ok(node: lp.Window) -> bool:
+    """True when a Window node runs sharded on the spine
+    (dplan._exec_window_dist): every expr is a plain WindowExpr — no
+    subqueries anywhere — computing a ranking or a whole-partition
+    aggregate.  Running frames (agg func + ORDER BY) need the
+    cross-row prefix scan and stay single-chip."""
+    for _name, e in node.exprs:
+        if not isinstance(e, ex.WindowExpr):
+            return False
+        if any(isinstance(x, ex.SubqueryExpr) for x in e.walk()):
+            return False
+        if e.func in WINDOW_RANKING_FUNCS:
+            continue
+        if e.func in WINDOW_AGG_FUNCS and not e.order_by:
+            continue
+        return False
+    return True
+
+
+def plan_path_to(root: lp.Plan, target: lp.Plan
+                 ) -> Optional[List[lp.Plan]]:
+    """Root-to-target node path, or None when target is not in the
+    tree (shared by dplan's union splitter and this audit)."""
+    if root is target:
+        return [root]
+    for c in root.children():
+        p = plan_path_to(c, target)
+        if p is not None:
+            return [root] + p
+    return None
+
+
+def union_distributive_path(root: lp.Plan, target: lp.Plan) -> bool:
+    """Aggregation over the union at `target` may be split per branch
+    only when every node between them distributes over UNION ALL:
+    row-wise ops, inner joins (either side), and probe-side-only for
+    left/semi/anti/mark joins (a build-side union would change match
+    semantics)."""
+    path = plan_path_to(root, target)
+    if path is None:
+        return False
+    for i, nd in enumerate(path[:-1]):
+        nxt = path[i + 1]
+        if isinstance(nd, (lp.Project, lp.Filter, lp.SubqueryAlias)):
+            continue
+        if isinstance(nd, lp.SetOp) and nd.kind == "union" and nd.all:
+            continue
+        if isinstance(nd, lp.Join):
+            if nd.kind == "inner" or (nxt is nd.left and nd.kind in
+                                      ("left", "semi", "anti",
+                                       "nullaware_anti", "mark")):
+                continue
+            return False
+        return False
+    return True
+
 
 # ---------------------------------------------------------------------------
 # Audit
@@ -445,6 +510,16 @@ class LoweringAuditor:
             self._emit("NDS301", "no sharded-size base-table scan: plan "
                        "runs single-chip", type(plan).__name__)
             return
+        usite = self._union_agg_site(plan)
+        if usite is not None:
+            # dplan._try_union_agg runs before the spine split: each
+            # union-all branch becomes its own sharded spine and the
+            # decomposable partials combine on the host, so the spine
+            # restrictions below never apply to this plan shape
+            self._emit("NDS309", "aggregate distributes over a union-all "
+                       "of sharded branches: per-branch spines, partials "
+                       "combined on the host", usite)
+            return
         target = facts[0]  # dplan tries largest-first; facts dominate
         chain = self._chain_to(plan, target)
         if chain is None:
@@ -480,9 +555,17 @@ class LoweringAuditor:
                            "single-chip", npath)
                 continue
             if fact_right and node.kind != "inner":
-                self._emit("NDS303", f"sharded table on the build side "
-                           f"of a {node.kind} join forces single-chip",
-                           npath)
+                if node.kind in SPMD_REDUCIBLE_BUILD_JOIN_KINDS and not (
+                        node.kind == "nullaware_anti" and
+                        node.extra is not None):
+                    self._emit("NDS308", f"sharded build side of a "
+                               f"{node.kind} join reduces to its "
+                               "distinct key tuples distributed",
+                               npath)
+                else:
+                    self._emit("NDS303", f"sharded table on the build "
+                               f"side of a {node.kind} join forces "
+                               "single-chip", npath)
             build = node.left if fact_right else node.right
             bschema = self.tc.infer(build)
             for i, (le, re_) in enumerate(node.keys):
@@ -504,7 +587,32 @@ class LoweringAuditor:
                 f"predicted exchange placement over {target.table}: "
                 f"{broadcast} broadcast join(s), {shuffle} shuffle "
                 "(all_to_all) join(s)", spine_path)
-        if not isinstance(spine, lp.Aggregate) and not any(
+        if isinstance(spine, lp.Aggregate):
+            return
+        # mirror dplan._split's tail/window detection: a Sort+Limit (or
+        # bare Limit) directly above the spine finalizes as a per-device
+        # top-k, and absorbed Window nodes run sharded — either one is
+        # distributed work, so NDS306 no longer applies
+        has_win = any(isinstance(chain[j][0], lp.Window)
+                      for j in range(spine_idx, len(chain)))
+        has_tail = False
+        i = spine_idx - 1
+        if i >= 0 and isinstance(chain[i][0], lp.Sort):
+            i -= 1
+        if i >= 0 and isinstance(chain[i][0], lp.Limit) and \
+                chain[i][0].n and int(chain[i][0].n) > 0:
+            has_tail = True
+        if has_tail or has_win:
+            what = []
+            if has_tail:
+                what.append("per-device top-k sort/limit gathers only "
+                            "the k-row result")
+            if has_win:
+                what.append("window runs sharded over the partition-"
+                            "colocating exchange")
+            self._emit("NDS310", "row spine finalizes on-device: "
+                       + "; ".join(what), spine_path)
+        elif not any(
                 isinstance(nd, (lp.Join, lp.Filter)) or
                 (isinstance(nd, lp.Scan) and nd.predicate is not None)
                 for nd in spine.walk()):
@@ -535,8 +643,47 @@ class LoweringAuditor:
     def _spine_ok(node: lp.Plan) -> bool:
         if isinstance(node, lp.Join):
             return node.kind in SPMD_SPINE_JOIN_KINDS
+        if isinstance(node, lp.Window):
+            return spmd_window_ok(node)
         return isinstance(node, (lp.Scan, lp.Filter, lp.Project,
                                  lp.SubqueryAlias))
+
+    def _union_agg_site(self, plan: lp.Plan) -> Optional[str]:
+        """Path of the deepest Aggregate that dplan._try_union_agg will
+        split over a distributive union-all of fact-bearing branches —
+        the site must pass the runtime's gating: decomposable agg funcs,
+        no DISTINCT leaves (cross-branch dedup unsupported), no window
+        inside the aggregate.  None when the plan takes the spine path."""
+        best: Optional[Tuple[int, str]] = None
+
+        def agg_ok(p: lp.Aggregate) -> bool:
+            for _, e in p.aggs:
+                for sub in e.walk():
+                    if isinstance(sub, ex.WindowExpr):
+                        return False
+                    if isinstance(sub, ex.AggExpr) and (
+                            sub.func not in SPMD_AGG_FUNCS or
+                            sub.distinct):
+                        return False
+            return True
+
+        def walk(p: lp.Plan, path: str, depth: int) -> None:
+            nonlocal best
+            if isinstance(p, lp.Aggregate) and agg_ok(p):
+                direct = [
+                    s for s in p.child.walk()
+                    if isinstance(s, lp.SetOp) and s.kind == "union"
+                    and s.all and union_distributive_path(p.child, s)
+                    and any(isinstance(n, lp.Scan) and
+                            n.table in SPMD_FACT_TABLES
+                            for n in s.walk())]
+                if direct and (best is None or depth > best[0]):
+                    best = (depth, path)
+            for i, c in enumerate(p.children()):
+                walk(c, _child_path(path, c, i), depth + 1)
+
+        walk(plan, type(plan).__name__, 0)
+        return best[1] if best is not None else None
 
     @staticmethod
     def _chain_to(plan: lp.Plan, target: lp.Plan

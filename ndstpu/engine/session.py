@@ -263,7 +263,22 @@ class Session:
             if cache is None:
                 cache = self._spmd_cache = {}
                 self._spmd_dev_cache = {}
-            ck = f"{self._views_epoch}|{key}" if key is not None else None
+            # shape-keyed SPMD cache: a canonical plan with an empty
+            # shape residual is keyed on fingerprint + bound-value hash
+            # (the values substitute back into literals before tracing,
+            # so distinct bindings are distinct compiled programs) and
+            # the parameterized exec plan rides with its binding;
+            # renderings differing only in text share one entry
+            spmd_plan, spmd_params = plan, None
+            if canon is not None and not canon.residual:
+                import hashlib
+                vh = hashlib.sha256(
+                    repr(canon.binding.values).encode()).hexdigest()[:16]
+                ck = f"{self._views_epoch}|{canon.cache_key}|v{vh}"
+                spmd_plan, spmd_params = canon.exec_plan, canon.binding
+            else:
+                ck = f"{self._views_epoch}|{key}" if key is not None \
+                    else None
             ent = cache.get(ck) if ck else None
             if ent is not None and ent[0] != versions:
                 # data changed: drop the stale executor (its pinned
@@ -292,7 +307,7 @@ class Session:
                     kw["chunk_rows"] = self.spmd_chunk_rows
                 exe = dplan.DistributedPlanExecutor(
                     self.catalog, self._mesh(), **kw)
-                out = exe.execute_plan(plan)
+                out = exe.execute_plan(spmd_plan, params=spmd_params)
                 if ck:
                     cache[ck] = (versions, exe)
                 self._spmd_used = True

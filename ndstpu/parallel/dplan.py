@@ -14,9 +14,23 @@ TPU-first rather than translated:
   collisions; the psum combine for dense keys lives in
   ndstpu.parallel.dquery, the all_to_all repartition in
   ndstpu.parallel.exchange).
-* **Build sides and the plan tail** (the tiny part: dimension subtrees,
-  final Sort/Limit/Project over a handful of groups) execute on the host
-  numpy interpreter — the driver side of a broadcast join.
+* **Existence-join build sides containing a fact** (q10/q35/q69
+  EXISTS-over-store_sales shape) are not host-executed wholesale: a
+  child executor reduces the build subtree to its distinct
+  (key, residual column) tuples distributed, and only that small
+  reduction broadcasts (:meth:`_reduce_build`).
+* **Window functions** whose exprs are ranking or whole-partition
+  aggregates run sharded: rows are colocated by a partition-key hash
+  exchange (all_to_all) and the window is computed per device with the
+  original row id as the deterministic tiebreak.
+* **Plan tails finalize on-device** where the shape allows: aggregate
+  combines are already an all_gather of partials, and a final
+  Sort+Limit (or bare Limit) above a row spine becomes a per-device
+  top-k plus a k-row all_gather — only the (small) result is fetched,
+  tracked by the ``engine.spmd.host_gather_bytes`` counter.
+* **Build sides and the remaining plan tail** (dimension subtrees,
+  final Project over a handful of groups) execute on the host numpy
+  interpreter — the driver side of a broadcast join.
 * Plans without a sharded-size table, or using operators outside the
   distributed subset, raise :class:`DistUnsupported`; callers fall back
   to the single-chip engine (ndstpu.engine.jaxexec).
@@ -40,7 +54,6 @@ jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ndstpu import obs
@@ -51,14 +64,21 @@ from ndstpu.engine.jaxexec import (
     DCol,
     DTable,
     JEval,
+    Unsupported,
     _DEAD_KEY,
+    _NULL32,
+    _NULL_KEY,
+    _ORD_DEAD32,
     _group_ids,
+    _key_col,
     _key_i64,
     _lexsort_order,
+    _minmax_vals,
+    _narrow_span,
     _sum_input,
     jnp_dtype,
 )
-from ndstpu.parallel.mesh import SHARD_AXIS
+from ndstpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 class DistUnsupported(Exception):
@@ -75,10 +95,10 @@ class DistUnsupported(Exception):
 
 def _has_params(plan: lp.Plan) -> bool:
     """True when any expression in the plan carries a parameter slot.
-    The session only hands dplan original (literal-bearing) plans — the
-    canonical exec_plan stays on the single-chip cache path — so this
-    guard exists to fail loud instead of tracing a Param into shard_map
-    if that invariant is ever broken upstream."""
+    Parameterized (canonical) plans can still take the SPMD path when
+    the caller supplies the binding — execute_plan substitutes the bound
+    values back into literals (:func:`bind_plan_params`) and compiles
+    the concrete plan, keyed upstream on fingerprint + value hash."""
     for node in plan.walk():
         for f in dataclasses.fields(node):
             v = getattr(node, f.name)
@@ -91,6 +111,64 @@ def _has_params(plan: lp.Plan) -> bool:
                         for x in it.walk()):
                     return True
     return False
+
+
+def _subst_params(e: ex.Expr, values) -> ex.Expr:
+    """Rebuild `e` with every Param/InParam replaced by the bound
+    literal / IN-list (slot-indexed into the canonicalizer's values)."""
+    if isinstance(e, ex.Param):
+        return ex.Literal(values[e.slot])
+    if isinstance(e, ex.InParam):
+        return ex.InList(_subst_params(e.operand, values),
+                         list(values[e.slot]), e.negated)
+    changed = False
+    kw = {}
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, ex.Expr):
+            nv = _subst_params(v, values)
+        elif isinstance(v, (list, tuple)):
+            nv = type(v)(
+                _subst_params(it, values) if isinstance(it, ex.Expr)
+                else (tuple(_subst_params(x, values)
+                            if isinstance(x, ex.Expr) else x for x in it)
+                      if isinstance(it, tuple) else it)
+                for it in v)
+            if nv == v:
+                nv = v
+        else:
+            nv = v
+        kw[f.name] = nv
+        changed = changed or nv is not v
+    return dataclasses.replace(e, **kw) if changed else e
+
+
+def bind_plan_params(plan: lp.Plan, binding) -> lp.Plan:
+    """Concrete copy of a canonical exec_plan: every Param/InParam slot
+    replaced by its bound value from ``binding`` (an
+    :class:`~ndstpu.engine.expr.ParamBinding`).  The SPMD compiler then
+    traces plain literals — shape slots were already substituted by the
+    canonicalizer, so the result is exactly the original plan's shape."""
+    values = binding.values if hasattr(binding, "values") else binding
+    plan = copy.deepcopy(plan)
+    for node in plan.walk():
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, ex.Expr):
+                setattr(node, f.name, _subst_params(v, values))
+            elif isinstance(v, (list, tuple)):
+                out = []
+                for it in v:
+                    if isinstance(it, ex.Expr):
+                        out.append(_subst_params(it, values))
+                    elif isinstance(it, tuple):
+                        out.append(tuple(
+                            _subst_params(x, values)
+                            if isinstance(x, ex.Expr) else x for x in it))
+                    else:
+                        out.append(it)
+                setattr(node, f.name, type(v)(out))
+    return plan
 
 
 _SPINE_NODES = (lp.Scan, lp.Filter, lp.Project, lp.Join, lp.SubqueryAlias)
@@ -192,16 +270,29 @@ class DistributedPlanExecutor:
         self._row_meta: Optional[List[tuple]] = None
         self._key_meta: Optional[List[tuple]] = None
         self._leaf_meta: Optional[List[tuple]] = None
+        # NDS3xx codes hit while probing candidates / child executors —
+        # kept even on success so spmd_coverage can report which raise
+        # sites the plan brushed against on its way to a working spine
+        self.attempt_codes: List[str] = []
+        # (join kind, reduced build rows) per _reduce_build success
+        self.build_reduced: List[tuple] = []
+        # on-device row-spine tail: (sort keys or None, LIMIT n)
+        self._tail: Optional[tuple] = None
+        # the spine absorbs Window nodes (rowid threading needed)
+        self._has_win = False
 
     # -- public --------------------------------------------------------------
 
-    def execute_plan(self, plan: lp.Plan) -> Table:
+    def execute_plan(self, plan: lp.Plan, params=None) -> Table:
         """Try candidate fact tables largest-first (at tiny scale factors
         a fixed-size dimension like date_dim can out-size the fact, and
         some spines fail preparation, e.g. non-unique build keys)."""
         if _has_params(plan):
-            raise DistUnsupported(
-                "parameterized (canonical) plan on spmd path", code="NDS301")
+            if params is None:
+                raise DistUnsupported(
+                    "parameterized (canonical) plan on spmd path without "
+                    "a binding", code="NDS301")
+            plan = bind_plan_params(plan, params)
         union = self._try_union_agg(plan)
         if union is not None:
             return union
@@ -223,10 +314,14 @@ class DistributedPlanExecutor:
             self.fact = None
             self.fact_target = target
             self._prepared = False
+            self._tail = None
+            self._has_win = False
             try:
                 spine, top = self._split(plan)
                 result = self._run_spine_retrying(spine)
             except DistUnsupported as e:
+                if e.code:
+                    self.attempt_codes.append(e.code)
                 last = e
                 continue
             self._spine, self._top = spine, top
@@ -278,7 +373,8 @@ class DistributedPlanExecutor:
                 broadcast_limit_rows=self.broadcast_limit,
                 dev_cache=self.dev_cache, chunk_rows=self.chunk_rows)
             firsts.append(child.execute_plan(s.plan))  # DistUnsupported
-            children.append((s, child))                # propagates
+            self.attempt_codes += child.attempt_codes  # propagates
+            children.append((s, child))
         self._scalar_ctx = (plan, children)
         return self._scalar_finish(firsts)
 
@@ -318,6 +414,8 @@ class DistributedPlanExecutor:
             self.fact = None
             self.fact_target = target
             self._prepared = False
+            self._tail = None
+            self._has_win = False
             try:
                 spine, top = self._split(plan)
                 if spine is not plan:
@@ -325,6 +423,8 @@ class DistributedPlanExecutor:
                         "branch spine is not the union aggregate")
                 out = self._run_spine_retrying(spine)
             except DistUnsupported as e:
+                if e.code:
+                    self.attempt_codes.append(e.code)
                 last = e
                 continue
             self._spine, self._top = spine, top
@@ -464,10 +564,14 @@ class DistributedPlanExecutor:
                 chunk_rows=self.chunk_rows)
             try:
                 kc, lps = exe.collect_partials(bplan)
+                self.attempt_codes += exe.attempt_codes
                 parts.append((kc, lps, list(exe._leaf_meta)))
                 sub_execs.append(exe)
                 any_dist = True
-            except DistUnsupported:
+            except DistUnsupported as du:
+                if du.code:
+                    self.attempt_codes.append(du.code)
+                self.attempt_codes += exe.attempt_codes
                 try:
                     kc, lps, meta = self._host_partials(bplan)
                 except Exception:  # noqa: BLE001 — any planner/eval gap
@@ -493,9 +597,11 @@ class DistributedPlanExecutor:
             chunk_rows=self.chunk_rows)
         try:
             out = nxt.execute_plan(rest)
+            self.attempt_codes += nxt.attempt_codes
             self._union_next = nxt
             return out
         except DistUnsupported:
+            self.attempt_codes += nxt.attempt_codes
             self._union_next = None
             return self.np_exec.execute(rest)
 
@@ -750,6 +856,11 @@ class DistributedPlanExecutor:
             if isinstance(node, lp.Join):
                 return node.kind in ("inner", "left", "semi", "anti",
                                     "nullaware_anti", "mark")
+            if isinstance(node, lp.Window):
+                # ranking / whole-partition aggregate windows run
+                # sharded after a partition-colocating exchange
+                # (shared legality check with the NDS310 audit)
+                return lowreg.spmd_window_ok(node)
             return isinstance(node, _SPINE_NODES)
 
         # longest spine-ok suffix of the chain ending at the fact scan;
@@ -763,12 +874,31 @@ class DistributedPlanExecutor:
                 ok_from = i
             else:
                 break
+        self._has_win = any(isinstance(nd, lp.Window)
+                            for nd in chain[ok_from:])
         if ok_from > 0 and isinstance(chain[ok_from - 1], lp.Aggregate):
             self._check_agg(chain[ok_from - 1])
             spine = chain[ok_from - 1]
         else:
             spine = chain[ok_from]
-        if not isinstance(spine, lp.Aggregate) and not any(
+        self._tail = None
+        if not isinstance(spine, lp.Aggregate):
+            # on-device row-spine tail: a Sort+Limit (or bare Limit)
+            # directly above the spine becomes a per-device top-k by
+            # (order keys, original row id) — the host then re-applies
+            # the tiny Sort/Limit over exactly those k rows, so the
+            # result is bit-identical to the single-chip path while
+            # only k*n_dev rows ever leave the device
+            i = ok_from - 1
+            sort_keys = None
+            if i >= 0 and isinstance(chain[i], lp.Sort):
+                sort_keys = list(chain[i].keys)
+                i -= 1
+            if i >= 0 and isinstance(chain[i], lp.Limit) and \
+                    chain[i].n and int(chain[i].n) > 0:
+                self._tail = (sort_keys, int(chain[i].n))
+        if not isinstance(spine, lp.Aggregate) and \
+                self._tail is None and not self._has_win and not any(
                 isinstance(nd, (lp.Join, lp.Filter)) or
                 (isinstance(nd, lp.Scan) and nd.predicate is not None)
                 for nd in spine.walk()):
@@ -842,13 +972,28 @@ class DistributedPlanExecutor:
             if not keys:
                 raise DistUnsupported("non-equi join on spine", code="NDS304")
             if not on_left:
+                if kind in lowreg.SPMD_REDUCIBLE_BUILD_JOIN_KINDS:
+                    # this candidate can't continue (the join's output
+                    # is the build side), but the probe-side anchor will
+                    # take the join with a distributed reduced build —
+                    # info, not a warning (see _reduce_build)
+                    raise DistUnsupported(
+                        f"sharded table on the build side of {kind} join",
+                        code="NDS308")
                 if kind != "inner":
                     raise DistUnsupported(
                         f"sharded table on the build side of {kind} join",
                         code="NDS303")
                 keys = [(r, l) for l, r in keys]
             build_plan = p.right if on_left else p.left
-            build = self.np_exec.execute(build_plan)
+            build = None
+            if kind in lowreg.SPMD_REDUCIBLE_BUILD_JOIN_KINDS and not (
+                    kind == "nullaware_anti" and p.extra is not None):
+                reduced = self._reduce_build(p, keys, build_plan)
+                if reduced is not None:
+                    build, keys = reduced
+            if build is None:
+                build = self.np_exec.execute(build_plan)
             probe_exprs = [l for l, _ in keys]
             bvalid = np.ones(build.num_rows, dtype=bool)
             key_parts = []
@@ -993,6 +1138,49 @@ class DistributedPlanExecutor:
                     collect(nd.extra)
         return refs
 
+    def _reduce_build(self, p: lp.Join, keys, build_plan: lp.Plan):
+        """Distributed reduction of an existence-join build side that
+        contains a sharded-size fact (q10/q35/q69 EXISTS-over-store_sales
+        shape): semi/anti/nullaware_anti/mark joins are insensitive to
+        build-side row multiplicity, so instead of executing the whole
+        build subtree on host numpy, a CHILD spine groups it by the join
+        keys (plus any residual-referenced build columns) over the mesh
+        and only the distinct tuples come back to broadcast.  Returns
+        (reduced_build_table, rewritten_keys) or None to keep the host
+        path (status quo) — any child failure degrades, never errors."""
+        if not any(isinstance(n, lp.Scan) and n.table in self.catalog and
+                   self.catalog.get(n.table).num_rows >= self.threshold
+                   for n in build_plan.walk()):
+            return None
+        group = [(f"__bk{i}", be) for i, (_pe, be) in enumerate(keys)]
+        if p.extra is not None:
+            names = _output_names(build_plan, self.catalog)
+            if names is None:
+                return None
+            used = {nd.name for nd in p.extra.walk()
+                    if isinstance(nd, ex.ColumnRef)}
+            group += [(c, ex.ColumnRef(c)) for c in sorted(used
+                                                           & set(names))]
+        bplan = lp.Aggregate(build_plan, group, [], None)
+        child = DistributedPlanExecutor(
+            self.catalog, self.mesh, self.threshold,
+            self.broadcast_limit, self.dev_cache,
+            chunk_rows=self.chunk_rows)
+        try:
+            reduced = child.execute_plan(bplan)
+        except (DistUnsupported, Unsupported) as e:
+            code = getattr(e, "code", None)
+            if code:
+                self.attempt_codes.append(code)
+            self.attempt_codes += child.attempt_codes
+            return None
+        self.attempt_codes += child.attempt_codes
+        self.build_reduced.append((p.kind, reduced.num_rows))
+        obs.inc("engine.spmd.build_reduce")
+        new_keys = [(pe, ex.ColumnRef(f"__bk{i}"))
+                    for i, (pe, _be) in enumerate(keys)]
+        return reduced, new_keys
+
     def _stage_shuffle_join(self, p: lp.Join, kind: str, probe_exprs,
                             radices, skeys: np.ndarray, row_of: np.ndarray,
                             build: Table, on_left: bool,
@@ -1064,6 +1252,14 @@ class DistributedPlanExecutor:
                         nd.name for nd in e.walk()
                         if isinstance(nd, ex.ColumnRef)}
             self._prepare(row_head)
+            if (self._tail is not None or self._has_win) and any(
+                    getattr(j, "dup_max", 0) and j.kind == "inner"
+                    for j in self.joins.values()):
+                # row ids number the pre-expansion fact rows; an
+                # expanding inner join duplicates them, breaking the
+                # deterministic tail/window tiebreak
+                raise DistUnsupported(
+                    "expanding inner join under a row-id tail/window")
             self._prepared = True
 
     def _run_spine_traced(self, spine: lp.Plan, agg, row_head) -> Table:
@@ -1083,8 +1279,11 @@ class DistributedPlanExecutor:
         # (one compiled program, per-chunk partials combined on the host
         # exactly like union branches).  DISTINCT needs all rows of a
         # group in one program, so it keeps the resident path.
+        # windows need every row of a partition resident in one program
+        # (the colocating exchange is per-launch), so they disable
+        # chunking; device tails chunk fine (per-chunk top-k supersets)
         chunked = (self.chunk_rows is not None and n > self.chunk_rows
-                   and not has_distinct)
+                   and not has_distinct and not self._has_win)
         rows_per = self.chunk_rows if chunked else max(n, 1)
         m = -(-max(rows_per, 1) // self.n_dev)
         padded = m * self.n_dev
@@ -1168,6 +1367,8 @@ class DistributedPlanExecutor:
         n_args = len(dev_args)
         n_fact_args = 2 * len(names) + 1
 
+        need_rowid = self._tail is not None or self._has_win
+
         def body(*args):
             self._cur_args = args
             self._drop_terms = []
@@ -1177,6 +1378,15 @@ class DistributedPlanExecutor:
             for i, (name, ctype, dictionary) in enumerate(metas):
                 dcols[name] = DCol(col_args[2 * i], col_args[2 * i + 1],
                                    ctype, dictionary)
+            if need_rowid:
+                # global pre-join row position: the deterministic
+                # tiebreak that makes the device tail / sharded window
+                # bit-identical to the single-chip stable sort (chunked
+                # mode reuses ids per chunk — chunk concat order plus a
+                # stable host sort restores the global order)
+                base = lax.axis_index(SHARD_AXIS).astype(jnp.int64) * m \
+                    + lax.iota(jnp.int64, m)
+                dcols["__rowid__"] = DCol(base, jnp.ones(m, bool), INT64)
             dt = self._exec(row_head, DTable(dcols, alive_arg))
             if has_distinct:
                 # DISTINCT needs every row of a group on one device:
@@ -1187,19 +1397,25 @@ class DistributedPlanExecutor:
             dropped = sum(self._drop_terms) if self._drop_terms \
                 else jnp.int64(0)
             if agg is None:
+                if self._tail is not None:
+                    return self._device_tail(dt), dropped
+                out_names = [nm for nm in dt.column_names
+                             if nm != "__rowid__"]
                 self._row_meta = [(nm, dt.columns[nm].ctype,
                                    dt.columns[nm].dictionary)
-                                  for nm in dt.column_names]
+                                  for nm in out_names]
                 flat = []
-                for nm in dt.column_names:
+                for nm in out_names:
                     flat += [dt.columns[nm].data, dt.columns[nm].valid]
                 return tuple(flat) + (dt.alive,), dropped
             return self._agg_partials(agg, agg_leaves, dt), dropped
 
+        row_spec = P(SHARD_AXIS) if (agg is None and self._tail is None) \
+            else P()
         sharded = shard_map(
             body, mesh=self.mesh,
             in_specs=tuple(P(SHARD_AXIS) for _ in range(n_args)),
-            out_specs=((P(SHARD_AXIS) if agg is None else P()), P()),
+            out_specs=(row_spec, P()),
             check_vma=False)
         self._agg_ctx = (agg, agg_leaves)
         self._compiled_fn = jax.jit(sharded)
@@ -1239,6 +1455,8 @@ class DistributedPlanExecutor:
         self._last_dropped = dropped_total
         if dropped_total:
             return None   # _run_spine_retrying re-traces with more slack
+        for out in outs:
+            self._note_host_gather(out)
         if agg is None:
             tables = []
             for out in outs:
@@ -1277,6 +1495,7 @@ class DistributedPlanExecutor:
             # truncated by a shuffle bucket overflow: the retry loop
             # discards this result, skip the host finalize
             return None
+        self._note_host_gather(out)
         agg, agg_leaves = self._agg_ctx
         if agg is not None:
             key_cols, leaf_parts = self._unpack_agg(out)
@@ -1305,8 +1524,12 @@ class DistributedPlanExecutor:
         if isinstance(p, lp.SubqueryAlias):
             dt = self._exec(p.child, dt)
             if p.column_aliases:
-                dt = DTable(dict(zip(p.column_aliases,
-                                     dt.columns.values())), dt.alive)
+                cols = dict(dt.columns)
+                rid = cols.pop("__rowid__", None)
+                cols = dict(zip(p.column_aliases, cols.values()))
+                if rid is not None:
+                    cols["__rowid__"] = rid
+                dt = DTable(cols, dt.alive)
             return dt
         if isinstance(p, lp.Filter):
             dt = self._exec(p.child, dt)
@@ -1315,7 +1538,14 @@ class DistributedPlanExecutor:
         if isinstance(p, lp.Project):
             dt = self._exec(p.child, dt)
             evl = JEval(dt)
-            return DTable({n: evl.eval(e) for n, e in p.exprs}, dt.alive)
+            out = {n: evl.eval(e) for n, e in p.exprs}
+            rid = dt.columns.get("__rowid__")
+            if rid is not None and "__rowid__" not in out:
+                out["__rowid__"] = rid
+            return DTable(out, dt.alive)
+        if isinstance(p, lp.Window):
+            dt = self._exec(p.child, dt)
+            return self._exec_window_dist(p, dt)
         if isinstance(p, lp.Join):
             bj = self.joins.get(id(p))
             if bj is None:
@@ -1576,10 +1806,19 @@ class DistributedPlanExecutor:
     def _colocate_by_group(self, agg: lp.Aggregate, dt: DTable) -> DTable:
         """Repartition live rows so every row of one group lands on the
         device owning hash(group keys)."""
+        return self._colocate_by_keys([e for _, e in agg.group_by], dt)
+
+    def _colocate_by_keys(self, key_exprs, dt: DTable) -> DTable:
+        """Repartition live rows so every row sharing the key tuple lands
+        on the device owning hash(keys) — the group/partition-colocating
+        all_to_all exchange (DISTINCT aggregation and sharded windows).
+        Empty keys collapse everything onto device 0 (a global window
+        partition); overflowed receive buckets report via _drop_terms and
+        the slack-doubling retry makes the exchange lossless."""
         from ndstpu.parallel import exchange
         evl = JEval(dt)
         cap = dt.capacity
-        keys = [_key_i64(evl.eval(e), dt.alive) for _, e in agg.group_by]
+        keys = [_key_i64(evl.eval(e), dt.alive) for e in key_exprs]
         h = jnp.zeros(cap, jnp.uint64)
         for k in keys:
             # float64 group keys keep their float encoding in _key_i64;
@@ -1602,6 +1841,226 @@ class DistributedPlanExecutor:
         self._drop_terms.append(n_dropped)
         return DTable({n: DCol(shuf["d" + n], shuf["v" + n], ct, dic)
                        for n, ct, dic in metas}, alive)
+
+    # -- sharded windows + device tail ---------------------------------------
+
+    def _exec_window_dist(self, p: lp.Window, dt: DTable) -> DTable:
+        """Sharded window functions: colocate rows by partition-key hash
+        (one all_to_all per distinct PARTITION BY list), then mirror the
+        single-chip _window_column per device with the original row id
+        as the deterministic ranking tiebreak (the exchange scrambles
+        local row order)."""
+        groups: Dict[str, list] = {}
+        gorder: List[str] = []
+        for name, e in p.exprs:
+            if not isinstance(e, ex.WindowExpr):
+                raise DistUnsupported("non-window expr in Window node")
+            gk = repr(tuple(e.partition_by))
+            if gk not in groups:
+                groups[gk] = []
+                gorder.append(gk)
+            groups[gk].append((name, e))
+        for gk in gorder:
+            exprs = groups[gk]
+            dt = self._colocate_by_keys(list(exprs[0][1].partition_by), dt)
+            cols = dict(dt.columns)
+            for name, w in exprs:
+                cols[name] = self._window_column_dist(dt, w)
+            dt = DTable(cols, dt.alive)
+        return dt
+
+    def _window_column_dist(self, dt: DTable, w: ex.WindowExpr) -> DCol:
+        """jaxexec._window_column mirror after the partition-colocating
+        exchange: every row of a partition is resident on this device, so
+        the local segment ops are globally exact.  Ranking sorts append
+        __rowid__ as the last sort key (replays the original row order
+        for ties); rank/dense_rank tie detection still looks at the
+        ORDER BY keys only.  Running frames and subquery-bearing exprs
+        never reach here (lowering.spmd_window_ok)."""
+        cap = dt.capacity
+        evl = JEval(dt)
+        if w.partition_by:
+            pcols = [evl.eval(e) for e in w.partition_by]
+            pkeys = [_key_col(c, dt.alive) for c in pcols]
+        else:
+            pkeys = [jnp.where(dt.alive, 0, 1).astype(jnp.int32)]
+        pid, _, _ = _group_ids(pkeys)
+        okeys = []
+        for e, asc in w.order_by:
+            c = evl.eval(e)
+            okeys.append(self._dev_order_key(evl, c, asc, None))
+        if w.func in ("row_number", "rank", "dense_rank"):
+            ridk = jnp.where(dt.alive, dt.columns["__rowid__"].data,
+                             _DEAD_KEY)
+            order = _lexsort_order([pid] + okeys + [ridk])
+            idx = lax.iota(jnp.int32, cap)
+            pid_s = pid[order]
+            newpart = jnp.ones(cap, bool)
+            if cap > 1:
+                newpart = newpart.at[1:].set(pid_s[1:] != pid_s[:-1])
+            part_start = lax.cummax(jnp.where(newpart, idx, 0))
+            pos_in_part = idx - part_start
+            inv = jnp.zeros(cap, jnp.int32).at[order].set(idx)
+            if w.func == "row_number":
+                return DCol((pos_in_part + 1)[inv].astype(jnp.int64),
+                            jnp.ones(cap, bool), INT64)
+            tie = jnp.zeros(cap, bool)
+            if cap > 1:
+                t = jnp.ones(cap - 1, bool)
+                for k in okeys:
+                    ks = k[order]
+                    t = t & (ks[1:] == ks[:-1])
+                tie = tie.at[1:].set(t & ~newpart[1:])
+            if w.func == "rank":
+                last_nontie = lax.cummax(jnp.where(~tie, idx, 0))
+                ranks = pos_in_part[last_nontie] + 1
+            else:
+                incr = jnp.where(newpart, 0, (~tie).astype(jnp.int32))
+                csum = jnp.cumsum(incr)
+                base = lax.cummax(jnp.where(newpart, csum, 0))
+                ranks = csum - base + 1
+            return DCol(ranks[inv].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
+        if w.order_by:
+            raise DistUnsupported("running window frame on spine")
+        gid = pid
+        if w.func == "count" and (w.arg is None or
+                                  isinstance(w.arg, ex.Star)):
+            cnt = jax.ops.segment_sum(dt.alive.astype(jnp.int32), gid,
+                                      num_segments=cap)
+            return DCol(cnt[gid].astype(jnp.int64), jnp.ones(cap, bool),
+                        INT64)
+        arg = evl.eval(w.arg)
+        valid = arg.valid & dt.alive
+        cnts = jax.ops.segment_sum(valid.astype(jnp.int32), gid,
+                                   num_segments=cap)
+        got = (cnts > 0)[gid]
+        if w.func == "count":
+            return DCol(cnts[gid].astype(jnp.int64),
+                        jnp.ones(cap, bool), INT64)
+        if w.func == "sum":
+            tot = jax.ops.segment_sum(
+                _sum_input(arg.data, valid, arg.ctype.kind), gid,
+                num_segments=cap)
+            if arg.ctype.kind == "decimal":
+                return DCol(tot[gid], got,
+                            columnar.decimal(38, arg.ctype.scale))
+            if arg.ctype.kind in ("int32", "int64"):
+                return DCol(tot[gid], got, INT64)
+            return DCol(tot[gid], got, FLOAT64)
+        if w.func == "avg":
+            tot = jax.ops.segment_sum(
+                _sum_input(arg.data, valid, arg.ctype.kind), gid,
+                num_segments=cap)
+            mean = tot.astype(jnp.float64) / jnp.maximum(cnts, 1)
+            if arg.ctype.kind == "decimal":
+                mean = mean / (10 ** arg.ctype.scale)
+            return DCol(mean[gid], got, FLOAT64)
+        if w.func in ("min", "max"):
+            if arg.ctype.kind == "float64":
+                init = jnp.inf if w.func == "min" else -jnp.inf
+                vals = jnp.where(valid, arg.data, init)
+                seg = (jax.ops.segment_min if w.func == "min"
+                       else jax.ops.segment_max)
+                return DCol(seg(vals, gid, num_segments=cap)[gid], got,
+                            arg.ctype)
+            vals = _minmax_vals(arg.data, valid, arg.ctype.kind,
+                                w.func == "min")
+            seg = (jax.ops.segment_min if w.func == "min"
+                   else jax.ops.segment_max)
+            out = seg(vals, gid, num_segments=cap)[gid]
+            return DCol(out.astype(arg.data.dtype), got, arg.ctype,
+                        arg.dictionary)
+        raise DistUnsupported(f"window {w.func} on spine")
+
+    def _dev_order_key(self, evl: JEval, c: DCol, asc: bool,
+                       nulls_first) -> jnp.ndarray:
+        """jaxexec._order_key mirror for traced spine sort keys (floats
+        order via +/-inf, narrow ints in int32, else int64; NULLs follow
+        nulls_first defaulting to the ascending side; dead rows strictly
+        last)."""
+        if nulls_first is None:
+            nulls_first = asc
+        alive = evl.t.alive
+        if c.ctype.kind == "float64":
+            data = c.data.astype(jnp.float64)
+            key = data if asc else -data
+            key = jnp.where(c.valid, key,
+                            -jnp.inf if nulls_first else jnp.inf)
+            return jnp.where(alive, key, jnp.inf)
+        if _narrow_span(c) is not None:
+            data = c.data.astype(jnp.int32)
+            key = data if asc else -data
+            key = jnp.where(c.valid, key,
+                            _NULL32 if nulls_first else -_NULL32)
+            return jnp.where(alive, key, _ORD_DEAD32)
+        data = c.data.astype(jnp.int64)
+        key = data if asc else -data
+        key = jnp.where(c.valid, key,
+                        _NULL_KEY if nulls_first else -_NULL_KEY)
+        return jnp.where(alive, key, _DEAD_KEY)
+
+    def _device_tail(self, dt: DTable):
+        """On-device top-k tail: per-device top `limit` rows by
+        (ORDER BY keys, original row id), then a k-row all_gather — the
+        host fetches n_dev*k rows instead of the whole sharded relation
+        and replays the suffix Sort/Limit over them.  The host's stable
+        sort keeps exactly the (okeys, rowid)-least rows, which is the
+        set selected here, so the differential stays bit-identical; a
+        bare LIMIT degenerates to rowid order = original row order."""
+        sort_keys, limit = self._tail
+        cap = dt.capacity
+        evl = JEval(dt)
+        okeys = []
+        for entry in (sort_keys or []):
+            e, asc = entry[0], entry[1]
+            nf = entry[2] if len(entry) > 2 else None
+            try:
+                c = evl.eval(e)
+            except Unsupported as u:
+                raise DistUnsupported(f"tail sort key: {u}", code=u.code)
+            okeys.append(self._dev_order_key(evl, c, asc, nf))
+        rid = dt.columns["__rowid__"].data
+        ridk = jnp.where(dt.alive, rid, _DEAD_KEY)
+        k = min(limit, cap)
+        order = _lexsort_order(okeys + [ridk])[:k]
+
+        def gather(x):
+            obs.inc("exchange.collective.calls")
+            obs.inc("exchange.all_gather.calls")
+            obs.inc("exchange.shuffle_bytes",
+                    int(x.size * x.dtype.itemsize
+                        * self.n_dev * (self.n_dev - 1)))
+            return lax.all_gather(x, SHARD_AXIS).reshape(
+                (self.n_dev * k,) + x.shape[1:])
+
+        # dead rows carry the dead-last order keys, so a device with
+        # fewer than k live rows pads the gather with rows that sort
+        # after every live one and are masked out host-side
+        g_alive = gather(dt.alive[order])
+        g_okeys = [gather(kk[order]) for kk in okeys]
+        g_rid = gather(ridk[order])
+        forder = _lexsort_order(g_okeys + [g_rid])[
+            :min(limit, self.n_dev * k)]
+        names = [nm for nm in dt.column_names if nm != "__rowid__"]
+        self._row_meta = [(nm, dt.columns[nm].ctype,
+                           dt.columns[nm].dictionary) for nm in names]
+        flat = []
+        for nm in names:
+            c = dt.columns[nm]
+            flat += [gather(c.data[order])[forder],
+                     gather(c.valid[order])[forder]]
+        return tuple(flat) + (g_alive[forder],)
+
+    @staticmethod
+    def _note_host_gather(out) -> None:
+        """Ledger evidence for the tail work: bytes actually fetched
+        device->host per spine launch (whole row relations before this
+        PR; agg partial tuples or a device tail's k-row result now)."""
+        total = 0
+        for a in out:
+            total += int(np.asarray(a).nbytes)
+        obs.inc("engine.spmd.host_gather_bytes", total)
 
     @staticmethod
     def _agg_leaves(agg: lp.Aggregate) -> List[ex.AggExpr]:
@@ -1638,6 +2097,7 @@ class DistributedPlanExecutor:
         def gather(x):
             # traced-collective instrument: counted once per compiled
             # program (see exchange._note_collective)
+            obs.inc("exchange.collective.calls")
             obs.inc("exchange.all_gather.calls")
             obs.inc("exchange.shuffle_bytes",
                     int(x.size * x.dtype.itemsize
@@ -2024,39 +2484,10 @@ class DistributedPlanExecutor:
         return Column(data, FLOAT64, None if ok.all() else ok)
 
 
-def _path_to(root: lp.Plan, target: lp.Plan) -> Optional[List[lp.Plan]]:
-    if root is target:
-        return [root]
-    for c in root.children():
-        p = _path_to(c, target)
-        if p is not None:
-            return [root] + p
-    return None
-
-
-def _distributive_path(root: lp.Plan, target: lp.Plan) -> bool:
-    """Aggregation over the union at `target` may be split per branch
-    only when every node between them distributes over UNION ALL:
-    row-wise ops, inner joins (either side), and probe-side-only for
-    left/semi/anti/mark joins (a build-side union would change match
-    semantics)."""
-    path = _path_to(root, target)
-    if path is None:
-        return False
-    for i, nd in enumerate(path[:-1]):
-        nxt = path[i + 1]
-        if isinstance(nd, (lp.Project, lp.Filter, lp.SubqueryAlias)):
-            continue
-        if isinstance(nd, lp.SetOp) and nd.kind == "union" and nd.all:
-            continue
-        if isinstance(nd, lp.Join):
-            if nd.kind == "inner" or (nxt is nd.left and nd.kind in
-                                      ("left", "semi", "anti",
-                                       "nullaware_anti", "mark")):
-                continue
-            return False
-        return False
-    return True
+# the union-distribution walk is shared with the static analyzer
+# (lowering._audit_spine models the same split the executor performs)
+_path_to = lowreg.plan_path_to
+_distributive_path = lowreg.union_distributive_path
 
 
 def _output_names(p: lp.Plan, catalog) -> Optional[List[str]]:

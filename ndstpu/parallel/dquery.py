@@ -30,14 +30,13 @@ jax.config.update("jax_enable_x64", True)  # exact int64 decimal sums
 
 import jax.numpy as jnp  # noqa: E402
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ndstpu.parallel.exchange import (
     hash_repartition,
     sharded_segment_sum,
 )
-from ndstpu.parallel.mesh import SHARD_AXIS
+from ndstpu.parallel.mesh import SHARD_AXIS, shard_map
 
 
 def build_q3_step(mesh: Mesh, n_items: int, n_dates: int, d_base: int,

@@ -35,6 +35,7 @@ def _note_collective(kind: str, bytes_est: int) -> None:
     all-devices total for one execution of the traced op."""
     from ndstpu import faults
     faults.check("exchange.collective", key=kind)
+    obs.inc("exchange.collective.calls")
     obs.inc(f"exchange.{kind}.calls")
     obs.inc("exchange.shuffle_bytes", int(bytes_est))
 

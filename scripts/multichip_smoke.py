@@ -1,0 +1,129 @@
+"""Multichip smoke: the SPMD executor on a 2-device CPU mesh.
+
+CI gate alongside the chaos/throughput smokes: renders a tiny warehouse,
+forces a **2-device** virtual mesh (the suite's 8-device conftest never
+exercises the minimal multi-chip topology), and drives one query from
+each newly-distributed plan class end to end through ``Session``
+(backend tpu-spmd):
+
+* an EXISTS semi join whose build side contains the fact
+  (dplan._reduce_build: no host build of the sharded table);
+* a ranking window over a partition-colocating exchange;
+* a Sort+LIMIT row tail finalized as a per-device top-k;
+* a plain star-join aggregate (the baseline spine).
+
+Each result must be row-identical to the numpy interpreter, the SPMD
+path must actually be used (no silent single-chip fallback), and the
+``engine.spmd.host_gather_bytes`` counter must tick — the evidence
+counter behind the "only the small result gathers" claim.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+N_DEV = 2
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={N_DEV}"
+    ).strip()
+# SPMD defects must fail the smoke, not degrade to single-chip
+os.environ.setdefault("NDSTPU_SPMD_STRICT", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+QUERIES = {
+    "semi_build_reduce": """
+        select count(*) as n from customer
+        where exists (select 1 from store_sales
+                      where ss_customer_sk = c_customer_sk)
+    """,
+    "window_rank": """
+        select ss_store_sk, ss_item_sk,
+               rank() over (partition by ss_store_sk
+                            order by ss_net_paid desc) as rnk
+        from store_sales where ss_net_paid > 90
+    """,
+    "sort_limit_tail": """
+        select ss_item_sk, ss_net_paid from store_sales
+        where ss_quantity > 10
+        order by ss_net_paid desc, ss_item_sk limit 25
+    """,
+    "star_join_agg": """
+        select i_class, sum(ss_ext_sales_price) as s
+        from store_sales, item where ss_item_sk = i_item_sk
+        group by i_class order by s desc
+    """,
+}
+
+
+def main() -> int:
+    from ndstpu import obs
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_mc_smoke"))
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    for cmd in (
+        [sys.executable, "-m", "ndstpu.datagen.driver", "local",
+         "0.002", "2", str(root / "raw")],
+        [sys.executable, "-m", "ndstpu.io.transcode",
+         "--input_prefix", str(root / "raw"),
+         "--output_prefix", str(root / "wh"),
+         "--report_file", str(root / "load.txt")],
+    ):
+        print("+", " ".join(cmd), flush=True)
+        subprocess.run(cmd, check=True, env=env,
+                       stdout=subprocess.DEVNULL)
+
+    assert len(jax.devices()) == N_DEV, \
+        f"expected a {N_DEV}-device mesh, got {len(jax.devices())}"
+    catalog = loader.load_catalog(str(root / "wh"))
+    spmd = Session(catalog, backend="tpu-spmd", spmd_threshold=500)
+    cpu = Session(catalog, backend="cpu")
+
+    failures = []
+    for name, sql in QUERIES.items():
+        before = obs.counters_snapshot()
+        spmd._spmd_used = False
+        got = spmd.sql(sql).to_rows()
+        want = cpu.sql(sql).to_rows()
+        delta = obs.counter_delta(before)
+        gathered = delta.get("engine.spmd.host_gather_bytes", 0)
+        used = getattr(spmd, "_spmd_used", False)
+        ok = used and got == want and gathered > 0
+        print(f"  {'OK  ' if ok else 'FAIL'} {name}: {len(got)} rows, "
+              f"spmd_used={used}, host_gather_bytes={gathered}",
+              flush=True)
+        if not used:
+            failures.append(f"{name}: SPMD path not used")
+        if got != want:
+            failures.append(f"{name}: rows differ from numpy oracle "
+                            f"({len(got)} vs {len(want)})")
+        if not gathered:
+            failures.append(f"{name}: host_gather_bytes did not tick")
+
+    if failures:
+        print("\nmultichip smoke FAILED:")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"\nmultichip smoke ok: {len(QUERIES)} plan classes "
+          f"distributed on a {N_DEV}-device mesh, row-equal, "
+          "host-gather evidence present")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

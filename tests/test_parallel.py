@@ -9,10 +9,10 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ndstpu.parallel import dquery, exchange, mesh as pmesh
+from ndstpu.parallel.mesh import shard_map
 
 
 @pytest.fixture(scope="module")
@@ -214,8 +214,8 @@ def test_session_spmd_backend(dist_catalog):
     assert sorted(map(str, a)) == sorted(map(str, b))
     assert getattr(spmd, "_spmd_used", False), \
         "distributed executor was never used"
-    # a window over the sharded scan distributes the scan and finishes
-    # the window in the host tail
+    # a window over the sharded scan runs sharded too: rows colocate by
+    # partition key (here: none -> one device) and rank on-device
     sql = ("select * from (select ss_item_sk, row_number() over "
            "(order by ss_net_paid desc, ss_item_sk) as rn from "
            "store_sales) t where rn <= 5")
@@ -223,13 +223,17 @@ def test_session_spmd_backend(dist_catalog):
     b = spmd.sql(sql).to_rows()
     assert sorted(map(str, a)) == sorted(map(str, b))
     # repeat execution takes the cached-executor path (no re-trace) and
-    # stays correct
+    # stays correct; the cache is keyed on the canonical plan
+    # fingerprint (parameterized plans share one compiled program)
+    from ndstpu import obs
     sql = ("select d_year, sum(ss_ext_sales_price) as s from store_sales, "
            "date_dim where ss_sold_date_sk = d_date_sk group by d_year "
            "order by d_year")
     first = spmd.sql(sql).to_rows()
-    assert sql in " ".join(k or "" for k in spmd._spmd_cache)
+    assert spmd._spmd_cache, "executor cache never populated"
+    before = obs.counters_snapshot()
     again = spmd.sql(sql).to_rows()
+    assert obs.counter_delta(before).get("engine.cache.spmd.hit", 0) >= 1
     assert first == again == cpu.sql(sql).to_rows()
     # not distributable (no sharded-size table) -> single-chip fallback
     spmd._spmd_used = False
@@ -516,15 +520,19 @@ def test_dist_dup_insensitive_semi_conversion(dist_catalog, mesh8):
 SPMD_CORPUS_TPLS = [
     "query2.tpl",    # CTE union reused twice (multi union sites)
     "query5.tpl",    # rollup over channels with nested unions
+    "query10.tpl",   # EXISTS build sides that contain sharded facts
     "query16.tpl",   # semi/anti self-join with residual runs
+    "query35.tpl",   # EXISTS-over-three-channels build reduction
     "query37.tpl",   # expanding inventory join -> semi conversion
     "query56.tpl",   # string join keys in union channels
+    "query69.tpl",   # EXISTS + NOT EXISTS mixed build reduction
     "query75.tpl",   # multi-channel union with fact-fact joins
     "query82.tpl",   # expanding inventory join -> semi conversion
     "query94.tpl",   # EXISTS/NOT EXISTS self-join residual runs
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("tpl", SPMD_CORPUS_TPLS)
 def test_spmd_corpus_differential(dist_catalog, mesh8, tpl):
     """The corpus queries that exercise the newest distributed paths
@@ -679,6 +687,183 @@ def test_dist_expanding_inner_broadcast_join(dist_catalog, mesh8):
         sorted(map(str, want.to_rows()))
 
 
+def test_dist_build_reduce_existence_join(dist_catalog, mesh8):
+    """q10/q35/q69 shape: an EXISTS / NOT EXISTS build side contains a
+    sharded-size fact.  Instead of executing the whole subtree on host
+    numpy, a child spine reduces it to its distinct join-key tuples over
+    the mesh (existence joins are insensitive to build multiplicity) and
+    only those broadcast."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    sql_exists = (
+        "select count(*) as c from store_sales where exists "
+        "(select 1 from web_sales where ws_item_sk = ss_item_sk)")
+    for sql in (sql_exists,
+                sql_exists.replace("where exists", "where not exists")):
+        plan, _ = sess.plan(sql)
+        want = physical.execute(plan, dist_catalog)
+        exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                            shard_threshold_rows=500)
+        got = exe.execute_plan(plan)
+        assert exe.build_reduced, f"build not reduced distributed: {sql}"
+        kind, n_reduced = exe.build_reduced[0]
+        assert kind in ("semi", "anti", "nullaware_anti", "mark")
+        # the reduction really deduplicated (distinct item keys < rows)
+        assert n_reduced < dist_catalog.get("web_sales").num_rows
+        rw = sorted(map(str, want.to_rows()))
+        assert sorted(map(str, got.to_rows())) == rw
+        assert sorted(map(str, exe.execute_again().to_rows())) == rw
+
+
+def test_dist_build_reduce_attempt_recovery(dist_catalog, mesh8):
+    """When the LARGEST fact sits on the build side, its anchored
+    candidate fails fast with NDS308 (recorded in attempt_codes), and
+    the probe-anchored candidate distributes with the reduced build —
+    the executor recovers instead of falling back to single-chip."""
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    assert dist_catalog.get("store_sales").num_rows > \
+        dist_catalog.get("web_sales").num_rows
+    sql = ("select count(*) as c from web_sales where exists "
+           "(select 1 from store_sales where ss_item_sk = ws_item_sk)")
+    plan, _ = sess.plan(sql)
+    want = physical.execute(plan, dist_catalog)
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=500)
+    got = exe.execute_plan(plan)
+    assert "NDS308" in exe.attempt_codes, \
+        "fact-on-build-side candidate should have failed with NDS308"
+    assert exe.build_reduced
+    assert sorted(map(str, got.to_rows())) == \
+        sorted(map(str, want.to_rows()))
+
+
+def test_dist_sharded_window(dist_catalog, mesh8):
+    """Ranking and whole-partition aggregate windows run sharded: rows
+    colocate by partition-key hash (one all_to_all per distinct
+    PARTITION BY list), ties replay the original row order."""
+    # rank with a duplicate-heavy order key
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_store_sk, ss_item_sk, "
+                 "rank() over (partition by ss_store_sk "
+                 "order by ss_net_paid desc) as rnk "
+                 "from store_sales where ss_net_paid > 90",
+                 threshold=500)
+    # two windows with DIFFERENT partition keys (two exchanges), plus
+    # row_number ties broken by original row order on both paths
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_ticket_number, "
+                 "row_number() over (partition by ss_store_sk "
+                 "order by ss_sold_date_sk, ss_ticket_number) as rn, "
+                 "dense_rank() over (partition by ss_item_sk "
+                 "order by ss_quantity desc) as dr "
+                 "from store_sales where ss_quantity > 80",
+                 threshold=500)
+    # whole-partition aggregates (no ORDER BY): order-independent
+    _dist_vs_cpu(dist_catalog, mesh8,
+                 "select ss_item_sk, ss_net_paid, "
+                 "sum(ss_net_paid) over (partition by ss_item_sk) as tot, "
+                 "count(*) over (partition by ss_item_sk) as n, "
+                 "avg(ss_quantity) over (partition by ss_item_sk) as aq "
+                 "from store_sales where ss_item_sk < 100",
+                 threshold=500)
+
+
+def test_dist_device_tail_topk(dist_catalog, mesh8):
+    """Sort+LIMIT (or bare LIMIT) above a row spine finalizes on-device
+    as a per-device top-k: only ~limit rows ever reach the host (the
+    host_gather_bytes counter is the evidence), and the result must be
+    bit-identical to the numpy interpreter INCLUDING row order."""
+    from ndstpu import obs
+    from ndstpu.engine import physical
+    from ndstpu.engine.session import Session
+    from ndstpu.parallel import dplan
+
+    sess = Session(dist_catalog, backend="cpu")
+    queries = [
+        # ordered top-k; desc + tiebreak column
+        "select ss_item_sk, ss_net_paid from store_sales "
+        "where ss_quantity > 10 "
+        "order by ss_net_paid desc, ss_item_sk limit 25",
+        # NULLable leading key, mixed asc/desc
+        "select ss_store_sk, ss_net_profit from store_sales "
+        "order by ss_store_sk, ss_net_profit desc limit 17",
+        # bare LIMIT: original row order, no sort keys at all
+        "select ss_item_sk, ss_ticket_number from store_sales limit 40",
+        # limit larger than the alive row count: dead-row padding in the
+        # gather must be masked out, every alive row survives
+        "select ss_item_sk from store_sales where ss_quantity > 99 "
+        "order by ss_item_sk limit 1000",
+    ]
+    for sql in queries:
+        plan, _ = sess.plan(sql)
+        want = physical.execute(plan, dist_catalog)
+        exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                            shard_threshold_rows=500)
+        before = obs.counters_snapshot()
+        got = exe.execute_plan(plan)
+        delta = obs.counter_delta(before)
+        assert exe._tail is not None, f"tail not on-device: {sql[:50]}"
+        assert want.column_names == got.column_names
+        # ORDER-SENSITIVE comparison: the whole point of the tail
+        assert [tuple(map(str, r)) for r in got.to_rows()] == \
+            [tuple(map(str, r)) for r in want.to_rows()], sql[:60]
+        assert delta.get("exchange.collective.calls", 0) >= 1
+        gathered = delta.get("engine.spmd.host_gather_bytes", 0)
+        assert gathered > 0
+        rw = [tuple(map(str, r)) for r in want.to_rows()]
+        assert [tuple(map(str, r))
+                for r in exe.execute_again().to_rows()] == rw
+    # evidence of the bytes DROP: the 25-row tail gathers orders of
+    # magnitude less than the sharded relation it ranks (which the
+    # pre-tail executor shipped to the host wholesale)
+    plan, _ = sess.plan(queries[0])
+    exe = dplan.DistributedPlanExecutor(dist_catalog, mesh8,
+                                        shard_threshold_rows=500)
+    before = obs.counters_snapshot()
+    exe.execute_plan(plan)
+    gathered = obs.counter_delta(before).get(
+        "engine.spmd.host_gather_bytes", 0)
+    n_fact = dist_catalog.get("store_sales").num_rows
+    assert 0 < gathered < n_fact * 2 * 8, \
+        f"tail gathered {gathered} bytes for {n_fact} fact rows"
+
+
+def test_session_spmd_parameterized_plans(dist_catalog):
+    """Parameterized (canonicalized) plans take the SPMD path: the
+    executor cache keys on the canonical fingerprint plus the bound
+    literal values (literals bake into the compiled program), where the
+    old executor rejected any plan with parameters (NDS301)."""
+    from ndstpu import obs
+    from ndstpu.engine.session import Session
+
+    cpu = Session(dist_catalog, backend="cpu")
+    spmd = Session(dist_catalog, backend="tpu-spmd", spmd_threshold=500)
+    tpl = ("select d_year, sum(ss_ext_sales_price) as s from store_sales"
+           ", date_dim where ss_sold_date_sk = d_date_sk "
+           "and ss_quantity > {} group by d_year order by d_year")
+    a = spmd.sql(tpl.format(10)).to_rows()
+    assert a == cpu.sql(tpl.format(10)).to_rows()
+    assert getattr(spmd, "_spmd_used", False), "SPMD path not used"
+    assert not getattr(spmd, "_spmd_errors", None)
+    # a different literal binds a different value hash (new entry, still
+    # distributed, still correct)
+    b = spmd.sql(tpl.format(90)).to_rows()
+    assert b == cpu.sql(tpl.format(90)).to_rows()
+    # the same literal again is a cache hit (no re-trace)
+    before = obs.counters_snapshot()
+    again = spmd.sql(tpl.format(10)).to_rows()
+    assert again == a
+    assert obs.counter_delta(before).get("engine.cache.spmd.hit", 0) >= 1
+
+
+@pytest.mark.slow
 def test_dist_full_corpus_row_equal(dist_catalog, mesh8):
     """EVERY corpus query part must (a) execute under the distributed
     executor on the 8-device mesh and (b) produce rows equal to the
