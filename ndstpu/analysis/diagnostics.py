@@ -73,6 +73,9 @@ CODES: Dict[str, Tuple[str, str]] = {
                        "partial combine)"),
     "NDS310": ("info", "row-spine tail (sort/limit/window) finalizes "
                        "on-device; only the small result gathers"),
+    "NDS311": ("warning", "configured chunked streaming fell back to the "
+                          "single-chip whole-fact path (the fact must fit "
+                          "HBM resident; spmd_chunk_rows is ignored there)"),
     # -- NDS4xx canonicalization / parameter lifting ----------------------
     "NDS401": ("info", "shape-affecting literal: value feeds static shape "
                        "or capacity planning (LIMIT, interval width, "

@@ -30,6 +30,11 @@ class _NullCM:
 _NULL_CM = _NullCM()
 
 
+class ChunkFallthroughError(RuntimeError):
+    """NDS311 under NDSTPU_SPMD_STRICT: configured chunked streaming
+    silently degraded to the single-chip whole-fact path."""
+
+
 @dataclass
 class Session:
     catalog: object  # ndstpu.io.loader.Catalog
@@ -42,9 +47,19 @@ class Session:
     backend: str = "cpu"
     # tpu-spmd: minimum table rows to shard (None = dplan default)
     spmd_threshold: Optional[int] = None
-    # tpu-spmd: stream facts larger than this through the device in
-    # chunks (out-of-core scan); None = whole-fact HBM-resident
-    spmd_chunk_rows: Optional[int] = None
+    # out-of-core streaming (tpu AND tpu-spmd): stream facts larger
+    # than this through the mesh shard-major in chunks of this many
+    # rows — each device scans only its own shard's chunks.  "auto"
+    # lets the spill-aware planner (engine/memplan.py) size chunks and
+    # prefetch depth from device memory stats; None = whole-fact
+    # HBM-resident.  On a multi-device mesh a plan shape the chunked
+    # executor cannot run falls back to the whole-fact single-chip
+    # path, defeating out-of-core — that fall-through is surfaced as
+    # diagnostic NDS311 (warning + counter; NDSTPU_SPMD_STRICT raises)
+    spmd_chunk_rows: Optional[object] = None
+    # chunks staged ahead of compute by the H2D prefetch ring
+    # (0 = synchronous streaming; None = planner/executor default)
+    spmd_prefetch_depth: Optional[int] = None
     # bumped on view create/drop — part of the compiled-query cache key
     # (same SQL text over a redefined view must not reuse a stale plan)
     _views_epoch: int = 0
@@ -69,6 +84,20 @@ class Session:
         import threading
 
         from ndstpu.engine.latch import KeyedLatch
+        if self.spmd_chunk_rows is not None and not (
+                self.spmd_chunk_rows == "auto"
+                or (isinstance(self.spmd_chunk_rows, int)
+                    and not isinstance(self.spmd_chunk_rows, bool)
+                    and self.spmd_chunk_rows > 0)):
+            raise ValueError(
+                f"spmd_chunk_rows must be a positive int, 'auto', or "
+                f"None, got {self.spmd_chunk_rows!r}")
+        if self.spmd_prefetch_depth is not None and (
+                not isinstance(self.spmd_prefetch_depth, int)
+                or self.spmd_prefetch_depth < 0):
+            raise ValueError(
+                f"spmd_prefetch_depth must be a non-negative int or "
+                f"None, got {self.spmd_prefetch_depth!r}")
         self._cache_lock = threading.RLock()
         self._exec_lock = threading.RLock()
         self._plan_latch = KeyedLatch()
@@ -305,6 +334,8 @@ class Session:
                     kw["shard_threshold_rows"] = self.spmd_threshold
                 if self.spmd_chunk_rows is not None:
                     kw["chunk_rows"] = self.spmd_chunk_rows
+                if self.spmd_prefetch_depth is not None:
+                    kw["prefetch_depth"] = self.spmd_prefetch_depth
                 exe = dplan.DistributedPlanExecutor(
                     self.catalog, self._mesh(), **kw)
                 out = exe.execute_plan(spmd_plan, params=spmd_params)
@@ -320,6 +351,7 @@ class Session:
                 obs.annotate(spmd_fallback=f"{code or 'uncoded'}: {u}")
                 if code:
                     obs.inc(f"engine.spmd.fallback.{code}")
+                self._note_chunk_fallthrough(u)
             except Exception as e:  # noqa: BLE001
                 # a distributed-executor defect must degrade to the
                 # single-chip path, not fail the query; strict mode
@@ -343,6 +375,33 @@ class Session:
                     plan, f"{self._views_epoch}|{key}")
             return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
+
+    def _note_chunk_fallthrough(self, u: Exception) -> None:
+        """NDS311: out-of-core streaming was configured on a multi-device
+        mesh but this plan fell back to the single-chip whole-fact path,
+        where `spmd_chunk_rows` is ignored and the fact must fit HBM
+        resident.  Silent before this diagnostic — a run configured for
+        SF100 streaming could quietly become a whole-fact load.  Warns
+        + counts (`engine.spmd.fallback.NDS311`); NDSTPU_SPMD_STRICT
+        turns it into an error."""
+        import os
+        import warnings
+
+        from ndstpu import obs
+        if self.spmd_chunk_rows is None or self.backend != "tpu-spmd" \
+                or self._mesh().devices.size <= 1:
+            return
+        code = getattr(u, "code", None)
+        msg = (f"NDS311: chunked streaming configured "
+               f"(spmd_chunk_rows={self.spmd_chunk_rows!r}) but this "
+               f"plan fell back to the single-chip whole-fact path "
+               f"({code or 'uncoded'}: {u}); the fact must fit HBM "
+               f"resident there")
+        obs.inc("engine.spmd.fallback.NDS311")
+        obs.annotate(chunk_fallthrough=f"{code or 'uncoded'}")
+        if os.environ.get("NDSTPU_SPMD_STRICT"):
+            raise ChunkFallthroughError(msg) from u
+        warnings.warn(msg, stacklevel=3)
 
     def _record_spmd_error(self, e: Exception) -> None:
         """A non-DistUnsupported distributed failure is a defect, not a

@@ -10,6 +10,8 @@ site                   probe location
 ``compile``            whole-query discovery/compile (jaxexec)
 ``execute``            statement execution (all backends)
 ``io.write``           artifact/table writes (atomic helper, transcode)
+``io.read``            streaming scan chunk reads (loader ChunkSource)
+``io.prefetch``        H2D staging ring background stage (jaxexec)
 ``exchange.collective``SPMD shuffle/broadcast/psum trace sites
 ``stream.worker``      in-process throughput stream worker entry
 ``phase.subprocess``   bench driver phase subprocess launch
@@ -43,8 +45,9 @@ from typing import Dict, List, Optional
 
 from ndstpu import obs
 
-SITES = ("plan", "compile", "execute", "io.write",
-         "exchange.collective", "stream.worker", "phase.subprocess")
+SITES = ("plan", "compile", "execute", "io.write", "io.read",
+         "io.prefetch", "exchange.collective", "stream.worker",
+         "phase.subprocess")
 
 KINDS = ("transient", "permanent", "hang")
 

@@ -466,7 +466,10 @@ def run_query_stream(args) -> None:
     if engine_conf.get("spmd.threshold_rows"):
         sess.spmd_threshold = int(engine_conf["spmd.threshold_rows"])
     if engine_conf.get("spmd.chunk_rows"):
-        sess.spmd_chunk_rows = int(engine_conf["spmd.chunk_rows"])
+        raw = engine_conf["spmd.chunk_rows"]
+        sess.spmd_chunk_rows = raw if raw == "auto" else int(raw)
+    if engine_conf.get("spmd.prefetch_depth"):
+        sess.spmd_prefetch_depth = int(engine_conf["spmd.prefetch_depth"])
     execution_times.append(
         (app_id, "CreateTempView all tables",
          int((time.time() - load_start) * 1000)))
@@ -569,6 +572,7 @@ def run_query_stream(args) -> None:
                                 warehouse=old.warehouse)
                 fresh.spmd_threshold = old.spmd_threshold
                 fresh.spmd_chunk_rows = old.spmd_chunk_rows
+                fresh.spmd_prefetch_depth = old.spmd_prefetch_depth
                 # swap FIRST: preload failure is non-fatal, but the
                 # stream must never continue on the session the
                 # zombie thread still drives

@@ -314,7 +314,11 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
     if engine_conf.get("spmd.threshold_rows"):
         session.spmd_threshold = int(engine_conf["spmd.threshold_rows"])
     if engine_conf.get("spmd.chunk_rows"):
-        session.spmd_chunk_rows = int(engine_conf["spmd.chunk_rows"])
+        raw = engine_conf["spmd.chunk_rows"]
+        session.spmd_chunk_rows = raw if raw == "auto" else int(raw)
+    if engine_conf.get("spmd.prefetch_depth"):
+        session.spmd_prefetch_depth = int(
+            engine_conf["spmd.prefetch_depth"])
     load_ms = int((time.time() - load_start) * 1000)
     if ns0.compile_records and accel:
         obs.set_gauge("harness.compile_records.present",

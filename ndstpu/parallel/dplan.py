@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import copy
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -241,16 +242,24 @@ class DistributedPlanExecutor:
     def __init__(self, catalog, mesh, shard_threshold_rows: int = 65536,
                  broadcast_limit_rows: int = lowreg.SPMD_BROADCAST_LIMIT_ROWS,
                  dev_cache: Optional[dict] = None,
-                 chunk_rows: Optional[int] = None):
+                 chunk_rows=None,
+                 prefetch_depth: Optional[int] = None):
         self.catalog = catalog
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.threshold = shard_threshold_rows
         self.broadcast_limit = broadcast_limit_rows
         # out-of-core: facts above this row count stream through the
-        # device in chunks of this size (one compiled program, partials
-        # combined across chunks on the host); None = whole-fact resident
+        # device shard-major — device d owns fact rows
+        # [d*shard_rows, (d+1)*shard_rows) and streams only its shard's
+        # chunks (one compiled program, partials combined across chunks
+        # on the host).  None = whole-fact resident; "auto" = the
+        # spill-aware planner (engine/memplan.py) sizes chunk_rows and
+        # the prefetch depth per fact from device memory stats
         self.chunk_rows = chunk_rows
+        # H2D staging ring depth (chunks staged ahead of compute);
+        # None = planner default, 0 = synchronous
+        self.prefetch_depth = prefetch_depth
         self.np_exec = physical.Executor(catalog)
         # shared (table, column, version) -> device arrays cache so many
         # cached query executors don't pin duplicate fact copies in HBM
@@ -371,7 +380,8 @@ class DistributedPlanExecutor:
                 self.catalog, self.mesh,
                 shard_threshold_rows=self.threshold,
                 broadcast_limit_rows=self.broadcast_limit,
-                dev_cache=self.dev_cache, chunk_rows=self.chunk_rows)
+                dev_cache=self.dev_cache, chunk_rows=self.chunk_rows,
+                prefetch_depth=self.prefetch_depth)
             firsts.append(child.execute_plan(s.plan))  # DistUnsupported
             self.attempt_codes += child.attempt_codes  # propagates
             children.append((s, child))
@@ -561,7 +571,8 @@ class DistributedPlanExecutor:
             exe = DistributedPlanExecutor(
                 self.catalog, self.mesh, self.threshold,
                 self.broadcast_limit, self.dev_cache,
-                chunk_rows=self.chunk_rows)
+                chunk_rows=self.chunk_rows,
+                prefetch_depth=self.prefetch_depth)
             try:
                 kc, lps = exe.collect_partials(bplan)
                 self.attempt_codes += exe.attempt_codes
@@ -594,7 +605,8 @@ class DistributedPlanExecutor:
         nxt = DistributedPlanExecutor(
             self.catalog, self.mesh, self.threshold,
             self.broadcast_limit, self.dev_cache,
-            chunk_rows=self.chunk_rows)
+            chunk_rows=self.chunk_rows,
+            prefetch_depth=self.prefetch_depth)
         try:
             out = nxt.execute_plan(rest)
             self.attempt_codes += nxt.attempt_codes
@@ -1165,7 +1177,8 @@ class DistributedPlanExecutor:
         child = DistributedPlanExecutor(
             self.catalog, self.mesh, self.threshold,
             self.broadcast_limit, self.dev_cache,
-            chunk_rows=self.chunk_rows)
+            chunk_rows=self.chunk_rows,
+            prefetch_depth=self.prefetch_depth)
         try:
             reduced = child.execute_plan(bplan)
         except (DistUnsupported, Unsupported) as e:
@@ -1282,11 +1295,20 @@ class DistributedPlanExecutor:
         # windows need every row of a partition resident in one program
         # (the colocating exchange is per-launch), so they disable
         # chunking; device tails chunk fine (per-chunk top-k supersets)
-        chunked = (self.chunk_rows is not None and n > self.chunk_rows
+        chunk_rows, depth = self._resolve_stream(fact_table, names, n)
+        chunked = (chunk_rows is not None and n > chunk_rows
                    and not has_distinct and not self._has_win)
-        rows_per = self.chunk_rows if chunked else max(n, 1)
-        m = -(-max(rows_per, 1) // self.n_dev)
+        # shard-major streaming geometry: device d owns the contiguous
+        # fact rows [d*shard_rows, (d+1)*shard_rows) and launch c
+        # streams the shard-local window [c*m, c*m+m) from every shard
+        # at once — each device only ever sees its own shard's chunks,
+        # and its scan stays a sequential read over its shard.
+        # Unchunked degenerates to m == shard_rows, one launch.
+        shard_rows = -(-max(n, 1) // self.n_dev)
+        m = min(max(-(-chunk_rows // self.n_dev), 1), shard_rows) \
+            if chunked else shard_rows
         padded = m * self.n_dev
+        n_launches = -(-shard_rows // m) if chunked else 1
         version = getattr(self.catalog, "versions", {}).get(
             self.fact.table)
         row_sh = NamedSharding(self.mesh, P(SHARD_AXIS))
@@ -1295,36 +1317,27 @@ class DistributedPlanExecutor:
                   fact_table.column(name).dictionary) for name in names]
         self._fact_metas = metas
 
-        def fact_args(start: int) -> list:
-            cnt = max(min(rows_per, n - start), 0)
-            args = []
-            for name in names:
-                c = fact_table.column(name)
-                if chunked:
-                    data = np.zeros(padded, dtype=c.data.dtype)
-                    data[:cnt] = c.data[start:start + cnt]
-                    valid = np.zeros(padded, dtype=bool)
-                    valid[:cnt] = c.validity()[start:start + cnt]
-                    args += [jax.device_put(data, row_sh),
-                             jax.device_put(valid, row_sh)]
-                    continue
-                ckey = (self.fact.table, name, version, padded)
-                ent = self.dev_cache.get(ckey)
-                if ent is None:
-                    self._evict_stale(self.fact.table, name)
-                    data = np.zeros(padded, dtype=c.data.dtype)
-                    data[:n] = c.data
-                    valid = np.zeros(padded, dtype=bool)
-                    valid[:n] = c.validity()
-                    ent = (jax.device_put(data, row_sh),
-                           jax.device_put(valid, row_sh))
-                    self.dev_cache[ckey] = ent
-                args += [ent[0], ent[1]]
-            if chunked:
-                alive = np.zeros(padded, dtype=bool)
-                alive[:cnt] = True
-                args.append(jax.device_put(alive, row_sh))
-            else:
+        if chunked:
+            fact_args = self._build_stream(fact_table, names, n,
+                                           shard_rows, m, padded,
+                                           n_launches, depth, row_sh)
+        else:
+            def fact_args(ci: int) -> list:
+                args = []
+                for name in names:
+                    c = fact_table.column(name)
+                    ckey = (self.fact.table, name, version, padded)
+                    ent = self.dev_cache.get(ckey)
+                    if ent is None:
+                        self._evict_stale(self.fact.table, name)
+                        data = np.zeros(padded, dtype=c.data.dtype)
+                        data[:n] = c.data
+                        valid = np.zeros(padded, dtype=bool)
+                        valid[:n] = c.validity()
+                        ent = (jax.device_put(data, row_sh),
+                               jax.device_put(valid, row_sh))
+                        self.dev_cache[ckey] = ent
+                    args += [ent[0], ent[1]]
                 akey = (self.fact.table, "__alive__", version, padded)
                 al = self.dev_cache.get(akey)
                 if al is None:
@@ -1334,7 +1347,7 @@ class DistributedPlanExecutor:
                     al = jax.device_put(alive, row_sh)
                     self.dev_cache[akey] = al
                 args.append(al)
-            return args
+                return args
 
         self._fact_args_fn = fact_args
         dev_args = fact_args(0)
@@ -1364,16 +1377,25 @@ class DistributedPlanExecutor:
                                 for nm, (_d, _v, ct, dic)
                                 in sj.cols_flat.items()}
             dev_args += dev
+        # shard-local launch offset: a tiny replicated scalar traced
+        # LAST (so the sharded fact/shuffle arg indices stay stable)
+        # that gives every launch its true global row ids
+        dev_args.append(np.int64(0))
         n_args = len(dev_args)
         n_fact_args = 2 * len(names) + 1
 
-        need_rowid = self._tail is not None or self._has_win
+        # chunked row-mode launches interleave shards, so they also
+        # need the global id to restore single-chip row order host-side
+        need_rowid = self._tail is not None or self._has_win \
+            or (chunked and agg is None)
+        self._emit_rowid = chunked
 
         def body(*args):
             self._cur_args = args
             self._drop_terms = []
             nf = len(metas)
             col_args, alive_arg = args[:2 * nf], args[2 * nf]
+            chunk_off = args[-1]
             dcols = {}
             for i, (name, ctype, dictionary) in enumerate(metas):
                 dcols[name] = DCol(col_args[2 * i], col_args[2 * i + 1],
@@ -1381,11 +1403,13 @@ class DistributedPlanExecutor:
             if need_rowid:
                 # global pre-join row position: the deterministic
                 # tiebreak that makes the device tail / sharded window
-                # bit-identical to the single-chip stable sort (chunked
-                # mode reuses ids per chunk — chunk concat order plus a
-                # stable host sort restores the global order)
-                base = lax.axis_index(SHARD_AXIS).astype(jnp.int64) * m \
-                    + lax.iota(jnp.int64, m)
+                # bit-identical to the single-chip stable sort.  Device
+                # d's launch c covers global rows d*shard_rows +
+                # chunk_off + [0, m); unchunked, chunk_off == 0 and
+                # shard_rows == m
+                base = (lax.axis_index(SHARD_AXIS).astype(jnp.int64)
+                        * shard_rows + chunk_off
+                        + lax.iota(jnp.int64, m))
                 dcols["__rowid__"] = DCol(base, jnp.ones(m, bool), INT64)
             dt = self._exec(row_head, DTable(dcols, alive_arg))
             if has_distinct:
@@ -1401,6 +1425,11 @@ class DistributedPlanExecutor:
                     return self._device_tail(dt), dropped
                 out_names = [nm for nm in dt.column_names
                              if nm != "__rowid__"]
+                if chunked:
+                    # carried through so _run_chunks can restore the
+                    # global row order after the shard-interleaved
+                    # launch concat (then dropped host-side)
+                    out_names.append("__rowid__")
                 self._row_meta = [(nm, dt.columns[nm].ctype,
                                    dt.columns[nm].dictionary)
                                   for nm in out_names]
@@ -1414,13 +1443,14 @@ class DistributedPlanExecutor:
             else P()
         sharded = shard_map(
             body, mesh=self.mesh,
-            in_specs=tuple(P(SHARD_AXIS) for _ in range(n_args)),
+            in_specs=tuple(P(SHARD_AXIS) for _ in range(n_args - 1))
+            + (P(),),
             out_specs=(row_spec, P()),
             check_vma=False)
         self._agg_ctx = (agg, agg_leaves)
         self._compiled_fn = jax.jit(sharded)
         self._dev_args = dev_args
-        self._chunk_info = (chunked, rows_per, n, n_fact_args)
+        self._chunk_info = (chunked, n_launches, m, n_fact_args)
         obs.inc("engine.spmd.traces")
         if not chunked:
             # jit is lazy: this first call pays shard_map trace + XLA
@@ -1433,25 +1463,142 @@ class DistributedPlanExecutor:
         with obs.span("spine_trace_exec", cat="plan-node", chunked=True):
             return self._run_chunks()
 
+    def _resolve_stream(self, fact_table, names, n):
+        """Resolve the session's chunk_rows / prefetch_depth setting to
+        concrete values for this fact.  ``"auto"`` defers to the
+        spill-aware planner (engine/memplan.py): chunk size and staging
+        depth come from the device memory budget and this fact's
+        scanned row width, not a hand-tuned constant."""
+        if self.chunk_rows == "auto":
+            from ndstpu.engine import memplan
+            bpr = memplan.row_bytes(
+                [fact_table.column(nm).data.dtype.itemsize
+                 for nm in names])
+            max_depth = self.prefetch_depth \
+                if self.prefetch_depth is not None \
+                else memplan.DEFAULT_MAX_DEPTH
+            plan = memplan.plan_stream(n, bpr, self.n_dev,
+                                       max_depth=max_depth)
+            obs.annotate(stream_plan=plan.describe())
+            obs.set_gauge("engine.stream.chunk_rows",
+                          plan.chunk_rows or 0)
+            obs.set_gauge("engine.stream.prefetch_depth",
+                          plan.prefetch_depth)
+            return plan.chunk_rows, plan.prefetch_depth
+        depth = self.prefetch_depth if self.prefetch_depth is not None \
+            else 2
+        return self.chunk_rows, max(int(depth), 0)
+
+    def _build_stream(self, fact_table, names, n, shard_rows, m,
+                      padded, n_launches, depth, row_sh):
+        """Wire the streaming pipeline for a chunked fact and return
+        the per-launch device-arg function.
+
+        Three overlapped stages (docs/ARCHITECTURE.md "Streaming
+        out-of-core pipeline"): a :class:`~ndstpu.io.loader.ChunkScanPool`
+        reads + decodes shard segments ahead on worker threads (from
+        the catalog's registered :class:`~ndstpu.io.loader.ChunkSource`
+        when one exists, else a ``TableChunkSource`` view of the
+        resident copy, so both paths exercise the same machinery); a
+        :class:`~ndstpu.engine.jaxexec.ChunkPrefetcher` stages the
+        decoded chunks into HBM with ``jax.device_put`` on a background
+        thread while the current launch computes.  ``depth == 0``
+        collapses both to synchronous streaming."""
+        from ndstpu.engine.jaxexec import ChunkPrefetcher
+        from ndstpu.io import loader as io_loader
+        source = getattr(self.catalog, "streams", {}).get(
+            self.fact.table)
+        if source is not None and (
+                source.num_rows != n
+                or not set(names) <= set(getattr(source, "columns", []))):
+            source = None   # stale or partial source: resident scan
+        if source is None:
+            source = io_loader.TableChunkSource(
+                fact_table, self.fact.table, names)
+
+        def host_chunk(ci: int) -> list:
+            """Scan/decode launch ci into padded shard-major host
+            arrays: [data, valid] per column + the alive mask."""
+            bufs = [(np.zeros(padded,
+                              dtype=fact_table.column(nm).data.dtype),
+                     np.zeros(padded, dtype=bool)) for nm in names]
+            alive = np.zeros(padded, dtype=bool)
+            off = ci * m
+            for d in range(self.n_dev):
+                g0 = d * shard_rows + off
+                cnt = max(min(m, shard_rows - off, n - g0), 0)
+                if cnt <= 0:
+                    continue
+                lo = d * m
+                payload = source.read(g0, cnt)
+                for (data, valid), nm in zip(bufs, names):
+                    data[lo:lo + cnt] = payload[nm][0]
+                    valid[lo:lo + cnt] = payload[nm][1]
+                alive[lo:lo + cnt] = True
+            flat = [a for pair in bufs for a in pair]
+            flat.append(alive)
+            return flat
+
+        old_pool = getattr(self, "_stream_pool", None)
+        if old_pool is not None:   # superseded by a slack retry retrace
+            old_pool.close()
+        old_pf = getattr(self, "_prefetch", None)
+        if old_pf is not None:
+            old_pf.close()
+        # scan runs one chunk further ahead than staging so the
+        # prefetcher's device_put never waits on a cold read
+        pool = io_loader.ChunkScanPool(
+            host_chunk, list(range(n_launches)),
+            workers=min(max(depth + 1, 1), 4),
+            depth=depth + 1 if depth else 0)
+        pool.start_ahead()   # cold reads overlap whole-query compile
+        self._stream_pool = pool
+        self._stream_fresh = True
+
+        def stage(ci: int) -> list:
+            host = pool.get(ci)
+            nbytes = sum(a.nbytes for a in host)
+            devs = [jax.device_put(a, row_sh) for a in host]
+            obs.inc("engine.h2d.bytes", nbytes)
+            return devs
+
+        self._prefetch = ChunkPrefetcher(stage, n_launches, depth=depth)
+        return self._prefetch.get
+
     def _run_chunks(self):
         """Out-of-core execution: stream fact chunks through the one
         compiled spine program; combine per-chunk outputs on the host
         (aggregate partials re-group like union branches, row-mode
         chunks concatenate)."""
-        _chunked, rows_per, n, n_fact_args = self._chunk_info
-        shuffle_args = self._dev_args[n_fact_args:]
+        _chunked, n_launches, m, n_fact_args = self._chunk_info
+        shuffle_args = self._dev_args[n_fact_args:-1]
         agg, leaves = self._agg_ctx
+        if getattr(self, "_stream_fresh", False):
+            self._stream_fresh = False
+        else:
+            # repeat pass over a cached chunked query: rewind the scan
+            # window and staging ring (chunk 0's device args persist
+            # from the first pass, so pre-stage from chunk 1)
+            pool = getattr(self, "_stream_pool", None)
+            if pool is not None:
+                pool.reset(next_idx=1)
+            pf = getattr(self, "_prefetch", None)
+            if pf is not None:
+                pf.reset(next_i=1)
         outs = []
         dropped_total = 0
-        for start in range(0, max(n, 1), rows_per):
-            args = (self._dev_args[:n_fact_args] if start == 0
-                    else self._fact_args_fn(start))
+        t_wall = time.monotonic()
+        for ci in range(n_launches):
+            args = (self._dev_args[:n_fact_args] if ci == 0
+                    else self._fact_args_fn(ci))
+            off = np.int64(ci * m)
             out, dropped = jax.device_get(
-                self._compiled_fn(*(list(args) + shuffle_args)))
+                self._compiled_fn(*(list(args) + shuffle_args + [off])))
             dropped_total += int(np.asarray(dropped))
             outs.append(out)
             if dropped_total:
                 break   # the whole pass is discarded and retried
+        obs.inc("engine.stream.execute_s", time.monotonic() - t_wall)
         self._last_dropped = dropped_total
         if dropped_total:
             return None   # _run_spine_retrying re-traces with more slack
@@ -1471,7 +1618,17 @@ class DistributedPlanExecutor:
                         data, ctype, None if valid.all() else valid,
                         dictionary)
                 tables.append(Table(cols))
-            return Table.concat(tables)
+            result = Table.concat(tables)
+            rid = result.columns.get("__rowid__")
+            if rid is not None:
+                # shard-major launches interleave the shards' windows;
+                # the threaded global row id restores the single-chip
+                # row order exactly (stable: duplicates from expanding
+                # joins keep their in-device expansion order)
+                result = result.gather(
+                    np.argsort(rid.data, kind="stable"))
+                result.columns.pop("__rowid__", None)
+            return result
         parts = [(*self._unpack_agg(out), list(self._leaf_meta))
                  for out in outs]
         if self._emit_partials:
@@ -2043,6 +2200,11 @@ class DistributedPlanExecutor:
         forder = _lexsort_order(g_okeys + [g_rid])[
             :min(limit, self.n_dev * k)]
         names = [nm for nm in dt.column_names if nm != "__rowid__"]
+        if getattr(self, "_emit_rowid", False):
+            # chunked tails: per-launch top-k supersets interleave the
+            # shards, so the host combine needs the global row id to
+            # restore original order before _finish replays Sort/Limit
+            names.append("__rowid__")
         self._row_meta = [(nm, dt.columns[nm].ctype,
                            dt.columns[nm].dictionary) for nm in names]
         flat = []
