@@ -23,17 +23,18 @@ import numpy as np
 
 from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
-from ndstpu.analysis import canon, diagnostics, lowering, typecheck
-from ndstpu.analysis.canon import CanonResult, canonicalize
+from ndstpu.analysis import canon, diagnostics, lowering, spines, typecheck
+from ndstpu.analysis.canon import (
+    CanonResult, canonicalize, canonicalize_subtrees)
 from ndstpu.analysis.diagnostics import Diagnostic
 from ndstpu.analysis.lowering import audit_plan
 from ndstpu.analysis.typecheck import infer_plan
 
 __all__ = [
     "AnalysisResult", "CanonResult", "Diagnostic", "analyze_plan",
-    "analyze_sql", "audit_plan", "canon", "canonicalize", "diagnostics",
-    "infer_plan", "lowering", "schema_catalog", "schema_tables",
-    "typecheck",
+    "analyze_sql", "audit_plan", "canon", "canonicalize",
+    "canonicalize_subtrees", "diagnostics", "infer_plan", "lowering",
+    "schema_catalog", "schema_tables", "spines", "typecheck",
 ]
 
 
@@ -73,6 +74,7 @@ class AnalysisResult:
     diagnostics: List[Diagnostic]     # NDS1xx..NDS4xx, sorted
     schema: typecheck.Schema
     canon: Optional[CanonResult] = None   # plan-shape canonicalization
+    spine_sites: Optional[List["spines.SpineSite"]] = None  # NDS5xx pass
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -88,29 +90,38 @@ class AnalysisResult:
 def analyze_plan(plan, tables: Optional[Dict[str, object]] = None,
                  query: str = "",
                  scale_factor: Optional[float] = None,
-                 spmd: bool = True) -> AnalysisResult:
+                 spmd: bool = True,
+                 spine_pass: bool = False) -> AnalysisResult:
     """Run schema inference (NDS1xx) + lowerability audit (NDS2xx/3xx)
-    over an optimized logical plan."""
+    over an optimized logical plan.  ``spine_pass=True`` also classifies
+    the plan's candidate common spines (NDS5xx inputs — the per-query
+    half of :func:`spines.build_index`)."""
     tables = tables if tables is not None else schema_tables()
     out_schema, type_diags = infer_plan(plan, tables, query=query,
                                         scale_factor=scale_factor)
     audit = audit_plan(plan, tables, query=query,
                        scale_factor=scale_factor, spmd=spmd)
     cres = canonicalize(plan, tables=tables, query=query)
+    sites = None
+    if spine_pass:
+        sites = spines.subtree_sites(plan, tables, query=query,
+                                     scale_factor=scale_factor)
     diags = diagnostics.sort_diagnostics(
         type_diags + audit.diagnostics + list(cres.diagnostics))
     return AnalysisResult(query=query, verdict=audit.verdict,
                           diagnostics=diags, schema=out_schema,
-                          canon=cres)
+                          canon=cres, spine_sites=sites)
 
 
 def analyze_sql(session, query: str, sql: str,
                 tables: Optional[Dict[str, object]] = None,
                 scale_factor: Optional[float] = None,
-                spmd: bool = True) -> AnalysisResult:
+                spmd: bool = True,
+                spine_pass: bool = False) -> AnalysisResult:
     """Plan one SQL statement through ``session`` (jax-free path) and
     analyze it.  ``session`` is an ``engine.session.Session`` — usually
     over :func:`schema_catalog` so no data is touched."""
     plan, _cols = session.plan(sql)
     return analyze_plan(plan, tables=tables, query=query,
-                        scale_factor=scale_factor, spmd=spmd)
+                        scale_factor=scale_factor, spmd=spmd,
+                        spine_pass=spine_pass)

@@ -20,6 +20,9 @@ Code ranges (docs/ARCHITECTURE.md "Static analysis"):
 * ``NDS4xx`` — plan canonicalization / parameter lifting
   (analysis/canon.py): which literal slots bind at runtime vs stay baked
   into the compiled program's shape
+* ``NDS5xx`` — cross-query common-spine sharing (analysis/spines.py):
+  which canonical subtrees recur across corpus parts and whether the
+  runtime spine-materialization cache may splice them
 
 The module is import-hygienic: no jax, no engine imports — it can run in
 a process that never initializes a backend (CI lint, doc tooling).
@@ -87,6 +90,19 @@ CODES: Dict[str, Tuple[str, str]] = {
                        "unclean IN-list)"),
     "NDS404": ("warning", "corpus part does not collapse to one canonical "
                           "fingerprint across probed streams/seeds"),
+    # -- NDS5xx cross-query common-spine sharing --------------------------
+    "NDS501": ("info", "shared-spine candidate: canonical subtree recurs "
+                       "across corpus parts and is runtime-spliceable"),
+    "NDS502": ("info", "param-divergent spine: subtrees share a canonical "
+                       "shape but bind different literal values, so the "
+                       "value-keyed materialization cache cannot serve "
+                       "one result to all of them"),
+    "NDS503": ("info", "nondeterministic/row-order-sensitive subtree "
+                       "(sort/window/limit inside): excluded from spine "
+                       "materialization"),
+    "NDS504": ("info", "estimated spine bytes exceed the memory-planner "
+                       "budget (memplan row-width model): materialization "
+                       "would not be admitted"),
 }
 
 _SEV_ORDER = {"error": 0, "warning": 1, "info": 2}

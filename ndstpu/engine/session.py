@@ -60,6 +60,11 @@ class Session:
     # chunks staged ahead of compute by the H2D prefetch ring
     # (0 = synchronous streaming; None = planner/executor default)
     spmd_prefetch_depth: Optional[int] = None
+    # cross-query spine-materialization cache (engine/spine.SpineCache);
+    # None = no sharing.  Installed by the inproc scheduler when its
+    # streams share flagged spines; NDSTPU_SPINES=0 kills splicing even
+    # when installed
+    spine_cache: Optional[object] = None
     # bumped on view create/drop — part of the compiled-query cache key
     # (same SQL text over a redefined view must not reuse a stale plan)
     _views_epoch: int = 0
@@ -150,6 +155,8 @@ class Session:
             # per-query mutable state is not safe under concurrent
             # statements, and one device runs programs serially anyway
             with self._exec_lock:
+                if getattr(self, "spine_cache", None) is not None:
+                    plan, canon = self._splice_spines(plan, canon, key)
                 out = self._execute(plan, key=key, canon=canon)
             return columnar.Table(dict(zip(disp, out.columns.values())))
         with self._exec_lock:
@@ -223,6 +230,130 @@ class Session:
             obs.inc("engine.canon.errors")
             obs.annotate(canon_error=f"{type(e).__name__}: {e}")
             return None
+
+    # -- cross-query spine sharing (engine/spine.py + analysis/spines.py) ----
+
+    def _spine_sites_for(self, plan: lp.Plan, key: str):
+        """Eligible spine sites for one cached plan: the outermost
+        non-overlapping shareable subtrees the analyzer flags
+        (analysis/spines.py — shared rule set with the MQO audit).
+        Memoized per query text; invalidated with the plan cache's
+        state so site node references always point into the plan
+        object `_plan_cached` currently serves."""
+        from ndstpu.analysis import spines as sp
+        memo = getattr(self, "_spine_sites_cache", None)
+        if memo is None:
+            with getattr(self, "_cache_lock", _NULL_CM):
+                memo = getattr(self, "_spine_sites_cache", None)
+                if memo is None:
+                    memo = self._spine_sites_cache = {}
+        ent = memo.get(key)
+        if ent is not None and ent[0] == id(plan):
+            return ent[1]
+        sites = sp.eligible_sites(sp.subtree_sites(plan, query=key))
+        with getattr(self, "_cache_lock", _NULL_CM):
+            memo[key] = (id(plan), sites)
+        return sites
+
+    def spine_candidate_keys(self, text: str) -> set:
+        """Value keys of the eligible spine sites in one query text —
+        what the scheduler counts across streams to decide which spines
+        are worth publishing (>= 2 occurrences)."""
+        from ndstpu.engine.sql import normalize_sql_key
+        try:
+            stmt = parse_statement(text)
+            if not isinstance(stmt, ast.Query):
+                return set()
+            key = normalize_sql_key(text)
+            plan, _disp, canon = self._plan_cached(stmt, key)
+            if canon is None:
+                return set()   # canonicalization off/failed: no splicing
+            return {s.value_key for s in self._spine_sites_for(plan, key)}
+        except Exception:  # noqa: BLE001 — unplannable text
+            return set()
+
+    def _splice_spines(self, plan: lp.Plan, canon, key: Optional[str]):
+        """Replace this plan's flagged spine subtrees with their
+        materialized tables (InlineTable), publishing on first use.
+
+        Requires a successful canonicalization and a text key: the
+        spliced plan re-canonicalizes before execution, and the
+        InlineTable content hash folds into that fingerprint, so the
+        spliced and unspliced programs get distinct compile-cache
+        entries by construction.  Runs under `_exec_lock` — the per-key
+        latch in the cache only adds materialize-once semantics for
+        callers outside it.  A materialization failure propagates like
+        any query failure (the harness retry/fault taxonomy owns it);
+        analysis failures just skip splicing."""
+        import os
+        if os.environ.get("NDSTPU_SPINES", "1") in ("", "0"):
+            return plan, canon
+        cache = self.spine_cache
+        if cache is None or canon is None or key is None:
+            return plan, canon
+        from ndstpu import obs
+        try:
+            sites = [s for s in self._spine_sites_for(plan, key)
+                     if cache.eligible(s.value_key)]
+        except Exception:  # noqa: BLE001 — analyzer defect: run unspliced
+            obs.inc("engine.spine.errors")
+            return plan, canon
+        if not sites:
+            return plan, canon
+        versions = tuple(sorted(
+            getattr(self.catalog, "versions", {}).items()))
+        state = (self._views_epoch, versions)
+        memo = getattr(self, "_spine_splice_memo", None)
+        if memo is None:
+            memo = self._spine_splice_memo = {}
+        from ndstpu.engine import spine as spine_mod
+        hits = 0
+        saved = 0
+        replacements = {}
+        spliced_keys = []
+        for site in sites:
+            vk = site.value_key
+            with cache.holding(vk):
+                t = cache.get(vk, state)
+                if t is None:
+                    obs.inc("engine.spine.miss")
+                    cache.misses += 1
+                    # materialize the subtree standalone; exceptions
+                    # propagate as this query's failure
+                    t = self._execute(site.node)
+                    cache.put(vk, state, t)
+                else:
+                    hits += 1
+                    cache.hits += 1
+                    nbytes = spine_mod.table_bytes(t)
+                    saved += nbytes
+                    obs.inc("engine.spine.hit")
+                    obs.inc("engine.spine.bytes", nbytes)
+            replacements[id(site.node)] = lp.InlineTable(
+                t, name=f"spine:{vk[:16]}")
+            spliced_keys.append(vk)
+        if hits:
+            obs.annotate(spine_hits=hits, spine_bytes_saved=saved)
+        # memo the spliced plan + its canon: same text + same spine
+        # tables + same state = same splice (tables are replaced, not
+        # mutated, so identity-keying on them is sound).  Host-memory
+        # pin until the memo entry rotates out (capped) — accepted.
+        mk = (key, tuple(spliced_keys), state,
+              tuple(id(r.table) for r in replacements.values()))
+        ent = memo.get(mk)
+        if ent is not None:
+            return ent
+        new_plan = spine_mod.replace_nodes(plan, replacements)
+        canon2 = self._canonicalize(new_plan, key)
+        if canon2 is None:
+            # without a canonical key the spliced plan would collide
+            # with the unspliced program under the text key — run
+            # unspliced instead (correct, just unshared)
+            return plan, canon
+        if len(memo) >= 256:
+            memo.pop(next(iter(memo)))
+        memo[mk] = (new_plan, canon2)
+        return new_plan, canon2
 
     def _plan_fresh(self, stmt: "ast.Query"):
         planner = pl.Planner(self.catalog, dict(self.views))

@@ -1,0 +1,168 @@
+"""Runtime spine-materialization cache (cross-query MQO).
+
+The analysis half (``analysis/spines.py``) proves which canonical plan
+subtrees recur across corpus parts; this module is the runtime half:
+an LRU table cache keyed on the subtree's *value key* (canonical
+fingerprint + hash over all slot values — a spine binding different
+literals is a different materialized table).  The first query to
+execute a flagged spine materializes the subtree and publishes the
+result; later queries splice the cached table in place of the subtree
+(``Session._splice_spines``) instead of recomputing the scan/filter/
+join work.
+
+Admission is byte-budgeted with the memory-planner's model: entries
+evict LRU-first so the cache never holds more than ``budget_bytes``,
+and a table bigger than the whole budget is simply not cached (the
+query still runs — it just doesn't share).  Entries carry the session
+state (views epoch + catalog versions) they were built under and are
+dropped on mismatch, mirroring ``Session._plan_cache`` semantics.
+
+Counters: ``engine.spine.hit`` / ``engine.spine.miss`` per flagged-site
+lookup, ``engine.spine.bytes`` cumulative bytes served from cache (the
+bytes-saved proxy), ``engine.spine.evict`` per eviction — all flowing
+into the obs sidecars and the run ledger.  ``NDSTPU_SPINES=0`` is the
+kill switch (checked by the splicer and the scheduler installer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Set, Tuple
+
+from ndstpu.engine import memplan, plan as lp
+from ndstpu.engine.latch import KeyedLatch
+
+
+def enabled() -> bool:
+    """NDSTPU_SPINES=0 kills all spine sharing (analysis still runs)."""
+    return os.environ.get("NDSTPU_SPINES", "1") not in ("", "0")
+
+
+def runtime_budget_bytes() -> Tuple[int, str]:
+    """Byte budget for the runtime cache: NDSTPU_SPINE_BUDGET_BYTES
+    wins (tests / operator pin), else the memory planner's per-device
+    budget scaled by its SAFETY fraction — the spine cache competes
+    with resident chunks for the same HBM."""
+    env = os.environ.get("NDSTPU_SPINE_BUDGET_BYTES")
+    if env:
+        return max(int(env), 1), "env"
+    budget, source = memplan.device_budget_bytes()
+    return max(int(budget * memplan.SAFETY), 1), source
+
+
+def table_bytes(t) -> int:
+    """Materialized size of a columnar.Table under the planner's model:
+    data + validity mask, plus a nominal 8 B/entry for string
+    dictionaries (object pointers; the decoded text lives host-side)."""
+    n = 0
+    for c in t.columns.values():
+        n += int(c.data.nbytes)
+        if c.valid is not None:
+            n += int(c.valid.nbytes)
+        if c.dictionary is not None:
+            n += 8 * len(c.dictionary)
+    return n
+
+
+class SpineCache:
+    """Byte-budgeted LRU of materialized spine tables.
+
+    ``flagged`` is the set of value keys worth publishing (the scheduler
+    flags keys that occur >= 2 times across its streams); ``None`` means
+    every eligible site publishes (tests).  Thread-safe; the per-key
+    latch gives materialize-once semantics to callers that publish
+    outside the session's execution lock."""
+
+    def __init__(self, budget_bytes: int,
+                 flagged: Optional[Set[str]] = None):
+        self.budget_bytes = max(int(budget_bytes), 0)
+        self.flagged = flagged
+        self._lock = threading.RLock()
+        self._latch = KeyedLatch()
+        # value_key -> [state, table, nbytes]; insertion order = LRU
+        self._entries: "OrderedDict[str, list]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def eligible(self, value_key: str) -> bool:
+        return self.flagged is None or value_key in self.flagged
+
+    def holding(self, value_key: str):
+        return self._latch.holding(value_key)
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, value_key: str, state):
+        """The cached table for ``value_key`` built under ``state``, or
+        None.  A stale-state entry is dropped (DML/view churn), exactly
+        like the session's plan cache."""
+        with self._lock:
+            ent = self._entries.get(value_key)
+            if ent is None:
+                return None
+            if ent[0] != state:
+                self._drop(value_key)
+                return None
+            self._entries.move_to_end(value_key)
+            return ent[1]
+
+    def put(self, value_key: str, state, table) -> bool:
+        """Publish a materialized spine; returns False when the table
+        alone exceeds the whole budget (not cached — the publisher's
+        query still ran, nothing is lost but the sharing)."""
+        nbytes = table_bytes(table)
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                return False
+            self._drop(value_key)
+            while self._bytes + nbytes > self.budget_bytes and \
+                    self._entries:
+                old, _ = self._entries.popitem(last=False)
+                self._bytes -= _[2]
+                self.evictions += 1
+                _obs_inc("engine.spine.evict")
+            self._entries[value_key] = [state, table, nbytes]
+            self._bytes += nbytes
+            return True
+
+    def _drop(self, value_key: str) -> None:
+        ent = self._entries.pop(value_key, None)
+        if ent is not None:
+            self._bytes -= ent[2]
+
+
+def _obs_inc(name: str, value: float = 1) -> None:
+    from ndstpu import obs
+    obs.inc(name, value)
+
+
+def replace_nodes(plan: lp.Plan,
+                  mapping: Dict[int, lp.Plan]) -> lp.Plan:
+    """Non-mutating rebuild of ``plan`` with ``mapping[id(node)]``
+    swapped in where present.  The cached plan object is shared across
+    streams (Session._plan_cache), so splicing must never touch it."""
+    r = mapping.get(id(plan))
+    if r is not None:
+        return r
+    if isinstance(plan, (lp.Join, lp.SetOp)):
+        return dataclasses.replace(
+            plan,
+            left=replace_nodes(plan.left, mapping),
+            right=replace_nodes(plan.right, mapping))
+    child = getattr(plan, "child", None)
+    if isinstance(child, lp.Plan):
+        return dataclasses.replace(plan,
+                                   child=replace_nodes(child, mapping))
+    return plan

@@ -762,6 +762,11 @@ def run_query_stream(args) -> None:
                             (q.get("attrs") or {}).get("spmd_fallback"),
                         "retry_attempts":
                             (q.get("attrs") or {}).get("retry_attempts"),
+                        "spine_hits":
+                            (q.get("attrs") or {}).get("spine_hits"),
+                        "spine_bytes_saved":
+                            (q.get("attrs") or {}).get(
+                                "spine_bytes_saved"),
                     }.items() if v})
                     for q in qsums
                     if not (q.get("attrs") or {}).get("error")]

@@ -381,6 +381,7 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
         {sid: dict(qd) for sid, qd in stream_queries.items()},
         budget_s=budget_s, est_cold=est_cold, est_warm=est_warm,
         key_fn=session.canonical_key)
+    _install_spine_cache(session, stream_queries)
 
     slots = concurrent if concurrent else 1
     gate = adm.InprocAdmission(slots)
@@ -491,6 +492,36 @@ def run_streams_inproc(stream_ids: List[str], cmd_template: List[str],
                      scheduler=sched, gate=gate)
 
 
+def _install_spine_cache(session, stream_queries) -> None:
+    """Flag the spine value-keys that recur across this phase's streams
+    and install the shared materialization cache on the session
+    (engine/spine.py).  Planning already happened — the StreamScheduler
+    constructor ran every text through ``session.canonical_key`` — so
+    counting candidates here reuses the plan + spine-site memos.  A key
+    occurring once shares with nobody and is not worth publishing.
+    NDSTPU_SPINES=0 disables; any defect degrades to no sharing."""
+    from ndstpu.engine import spine as spine_mod
+    if not spine_mod.enabled():
+        return
+    try:
+        counts: Dict[str, int] = {}
+        for qd in stream_queries.values():
+            for sql in qd.values():
+                for vk in session.spine_candidate_keys(sql):
+                    counts[vk] = counts.get(vk, 0) + 1
+        flagged = {vk for vk, n in counts.items() if n >= 2}
+        if not flagged:
+            return
+        budget, source = spine_mod.runtime_budget_bytes()
+        session.spine_cache = spine_mod.SpineCache(budget, flagged)
+        obs.set_gauge("engine.spine.flagged", len(flagged))
+        print(f"[spine] {len(flagged)} shared spine(s) flagged across "
+              f"{len(stream_queries)} streams "
+              f"(budget {budget >> 20}MiB/{source})")
+    except Exception as e:  # noqa: BLE001 — sharing is an optimization
+        print(f"WARNING: spine cache not installed: {e}")
+
+
 def _write_stream_time_log(ns, res: dict, load_ms: int,
                            t0: float) -> None:
     """Per-stream CSV time log with the same row contract as the power
@@ -580,6 +611,10 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
                         (q.get("attrs") or {}).get("spmd_fallback"),
                     "retry_attempts":
                         (q.get("attrs") or {}).get("retry_attempts"),
+                    "spine_hits":
+                        (q.get("attrs") or {}).get("spine_hits"),
+                    "spine_bytes_saved":
+                        (q.get("attrs") or {}).get("spine_bytes_saved"),
                 }.items() if v})
                 for q in qsums
                 if not (q.get("attrs") or {}).get("error")]

@@ -6,7 +6,8 @@ suite via tests/test_doc_lint.py):
 1. **Citation lint** — scan ``docs/*.md`` (and README.md / a root
    STATUS.md) for cited artifact paths (``docs/*.json``/``docs/*.csv``
    and root ``BENCH_*.json`` / ``PLAN_LINT.json`` / ``PLAN_LINT.md`` /
-   ``CANON_AUDIT.json`` / ``CANON_AUDIT.md``)
+   ``CANON_AUDIT.json`` / ``CANON_AUDIT.md`` / ``MQO_AUDIT.json`` /
+   ``MQO_AUDIT.md``)
    and fail when a cited file is absent
    from the tree.  A citation whose line carries an explicit
    not-here-yet marker (``pending``, ``uncommitted``,
@@ -40,6 +41,7 @@ CITED_RE = re.compile(
     r"|\bBENCH_[A-Za-z0-9_.\-]*\.json\b"
     r"|\bPLAN_LINT\.(?:json|md)\b"
     r"|\bCANON_AUDIT\.(?:json|md)\b"
+    r"|\bMQO_AUDIT\.(?:json|md)\b"
     r"|\bRUN_STATE\.json\b")
 
 EXEMPT_MARKERS = ("pending", "uncommitted", "not committed")

@@ -69,8 +69,19 @@ def make_entry(query: str, wall_s: float, compile_s: float = 0.0,
     """One ledger line.  ``warmth`` defaults to the measured
     compile/execute-split classification; pass it explicitly only for
     legacy artifacts that recorded the phase out of band (e.g. the
-    warm-corpus discover/steady passes)."""
+    warm-corpus discover/steady passes).
+
+    A warm execution that was served cached spine tables
+    (``extra.spine_hits`` > 0, engine/spine.py) is its own warmth
+    class — ``spine-warm`` — because its wall is not comparable to a
+    plain warm replay: it skipped the spine's scan/filter/join work
+    entirely.  Keeping it out of the ``warm`` fingerprint means spine
+    hits can never deflate ``best_warm`` baselines (and the sentinel
+    can price the hit value explicitly)."""
     w = warmth or derive_warmth(wall_s, compile_s)
+    if warmth is None and w == "warm" and extra and \
+            extra.get("spine_hits"):
+        w = "spine-warm"
     e = {
         "v": 1,
         "ts": round(time.time() if ts is None else ts, 3),

@@ -20,6 +20,13 @@ best-known-warm ledger prior (ndstpu/obs/ledger.py):
   ``failed-transient`` or ``failed-permanent``; a failure that never
   went through the retry layer keeps the bare ``failed``.
 
+A query that was served cached spine tables (``attrs.spine_hits``,
+engine/spine.py) additionally carries ``warmth: "spine-warm"`` +
+``spine_hits``/``spine_bytes_saved`` on its verdict: its wall against
+the plain-warm baseline is the measured value of the spine cache, and
+the matching ledger entries land under the ``spine-warm`` fingerprint
+so they never become warm baselines themselves.
+
 Only ``regressed`` verdicts are exit-code-worthy: the CLI wrapper
 (scripts/regression_check.py) exits nonzero on genuine warm-path
 regressions so CI and the bench driver both see them, and writes
@@ -116,10 +123,26 @@ def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
             continue
         base = led.best_warm(name, engine=engine,
                              scale_factor=scale_factor)
-        verdicts.append(classify_query(
+        v = classify_query(
             name, q.get("wall_s", 0.0), q.get("compile_s", 0.0),
             q.get("execute_s", 0.0), base, rel_tol=rel_tol,
-            abs_floor_s=abs_floor_s))
+            abs_floor_s=abs_floor_s)
+        # spine-warm is its own warmth class (ndstpu/obs/ledger.py):
+        # a query served cached spine tables (engine/spine.py) skipped
+        # its spine's scan/filter/join work, so its wall is measured
+        # hit VALUE against the plain-warm baseline, never a new
+        # baseline itself.  The stamp keeps that measurable per query
+        # without widening the fixed VERDICTS set.
+        attrs = q.get("attrs") or {}
+        if attrs.get("spine_hits"):
+            v["warmth"] = "cold" if v["verdict"] == "cold-compile" \
+                else "spine-warm"
+            v["spine_hits"] = attrs["spine_hits"]
+            if attrs.get("spine_bytes_saved"):
+                v["spine_bytes_saved"] = attrs["spine_bytes_saved"]
+            v["reason"] += (f" [spine-warm: {attrs['spine_hits']} "
+                            f"cached-spine hit(s)]")
+        verdicts.append(v)
     counts: dict = {}
     for v in verdicts:
         counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1

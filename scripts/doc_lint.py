@@ -1,8 +1,9 @@
 """Doc/artifact honesty lint CLI (ndstpu/obs/artifact_lint.py).
 
 Fails (exit 1) when committed prose cites an artifact that is not in
-the tree (including the root ``PLAN_LINT.*`` / ``CANON_AUDIT.*``
-sweeps), or when a ``docs/*.json`` artifact pins ``engine_defaults``
+the tree (including the root ``PLAN_LINT.*`` / ``CANON_AUDIT.*`` /
+``MQO_AUDIT.*`` sweeps), or when a ``docs/*.json`` artifact pins
+``engine_defaults``
 that no longer match the engine source and is not stamped stale.
 
     python scripts/doc_lint.py [--root PATH]

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Corpus-wide multi-query-optimization (common-spine) audit.
+
+Sweeps every part of the power corpus through the static analyzer's
+subtree pass (ndstpu/analysis/spines.py) — parse → plan → optimize →
+per-subtree canonicalization over a ZERO-ROW schema catalog, so no
+warehouse, no data, no jax — and builds the cross-corpus common-spine
+index: which canonical subtrees ("spines") recur across DIFFERENT
+query parts, and whether the runtime spine cache
+(ndstpu/engine/spine.py) could legally materialize each one once and
+splice it into every consumer.
+
+Emits:
+
+* ``MQO_AUDIT.json`` / ``MQO_AUDIT.md`` (repo root): the shared-spine
+  index (fingerprint → consuming parts, byte estimate, shareability
+  verdict) plus NDS5xx diagnostics.  Deterministic (no timestamps) so
+  committed copies only change when the plans or the analyzer change.
+* NDS5xx diagnostics per shared spine: NDS501 shared-spine candidate,
+  NDS502 param-divergent (shared shape, different literal bindings —
+  compile-shareable but not result-shareable), NDS503 order-sensitive
+  (sort/window/limit inside — splicing could reorder rows), NDS504
+  estimated bytes over the materialization budget (memplan row-width
+  model).  With ``--baseline [PATH]``: exit nonzero iff a diagnostic
+  is NOT in the committed baseline (docs/mqo_audit_baseline.json).
+* With ``--write-baseline``: regenerate the baseline from this sweep.
+
+Usage:
+    python scripts/mqo_audit.py                      # artifacts only
+    python scripts/mqo_audit.py --baseline           # CI gate
+    python scripts/mqo_audit.py --write-baseline     # accept current set
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / "docs" / "mqo_audit_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                    default=None, metavar="PATH",
+                    help="gate against this baseline (default: "
+                         "docs/mqo_audit_baseline.json); exit 1 on new "
+                         "diagnostics")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from this sweep")
+    ap.add_argument("--json", default=str(REPO / "MQO_AUDIT.json"))
+    ap.add_argument("--md", default=str(REPO / "MQO_AUDIT.md"))
+    ap.add_argument("--rngseed", default="07291122510",
+                    help="stream seed (pinned bench seed by default so "
+                         "the artifact is reproducible)")
+    ap.add_argument("--stream", type=int, default=0)
+    ap.add_argument("--scale_factor", type=float, default=1.0,
+                    help="scale factor for the NDS504 byte estimates")
+    ap.add_argument("--sub_queries", default=None,
+                    help="comma-separated query-part subset (CI tiny run)")
+    return ap
+
+
+def sweep(args):
+    """part -> [SpineSite, ...] plus per-part analysis errors."""
+    from ndstpu import analysis
+    from ndstpu.engine.session import Session
+    from ndstpu.queries import streamgen
+
+    sess = Session(analysis.schema_catalog())
+    tables = analysis.schema_tables()
+    subset = set(args.sub_queries.split(",")) if args.sub_queries else None
+
+    per_sites, errors = {}, {}
+    for name, sql in streamgen.render_power_corpus(
+            rngseed=args.rngseed, stream=args.stream):
+        if subset is not None and name not in subset:
+            continue
+        try:
+            res = analysis.analyze_sql(sess, name, sql, tables=tables,
+                                       scale_factor=args.scale_factor,
+                                       spine_pass=True)
+            per_sites[name] = res.spine_sites or []
+        except Exception as e:
+            errors[name] = f"{type(e).__name__}: {e}"
+            per_sites[name] = []
+    return per_sites, errors
+
+
+def run_audit(args) -> int:
+    from ndstpu.analysis import diagnostics as diag_mod
+    from ndstpu.analysis import spines
+
+    per_sites, errors = sweep(args)
+    budget, budget_source = spines.spine_budget_bytes()
+    index, diags = spines.build_index(per_sites, budget_bytes=budget)
+    doc = spines.index_to_doc(index, budget_bytes=budget)
+
+    meta = {
+        "rngseed": args.rngseed,
+        "stream": args.stream,
+        "scale_factor": args.scale_factor,
+        "parts": len(per_sites),
+        "errors": errors,
+        "subtrees_indexed": doc["subtrees_indexed"],
+        "budget_bytes": doc["budget_bytes"],
+        "budget_source": budget_source,
+    }
+    meta.update(doc["summary"])
+
+    out = {"meta": meta,
+           "shared_spines": doc["shared_spines"],
+           "diagnostics": [d.as_dict()
+                           for d in diag_mod.sort_diagnostics(diags)]}
+    pathlib.Path(args.json).write_text(
+        json.dumps(out, indent=2, sort_keys=True) + "\n")
+
+    lines = ["# Multi-query optimization audit (common spines)", ""]
+    for k, v in sorted(meta.items()):
+        lines.append(f"- **{k}**: {v}")
+    lines += [
+        "",
+        f"{meta['shared_spine_candidates']} canonical subtrees are "
+        f"shareable across >= 2 parts of the corpus "
+        f"({meta['param_divergent']} of them param-divergent: one "
+        "compiled shape, different literal bindings, so only "
+        "value-identical renderings share a materialized result). "
+        f"{meta['order_sensitive']} recurring subtrees are "
+        "order-sensitive and excluded; "
+        f"{meta['over_budget']} exceed the materialization budget.",
+        "",
+        "| fingerprint | kind | parts | n | value sets | est bytes "
+        "| shareable |",
+        "|---|---|---|---|---|---|---|"]
+    for s in doc["shared_spines"]:
+        qs = ", ".join(s["queries"])
+        share = "yes" if s["shareable"] else f"**no** ({s['reason']})"
+        lines.append(
+            f"| `{s['fingerprint']}` | {s['kind']} | {qs} "
+            f"| {s['n_queries']} | {s['n_value_sets']} "
+            f"| {s['est_bytes'] if s['est_bytes'] is not None else '?'} "
+            f"| {share} |")
+    if diags:
+        lines += ["", "## Diagnostics", ""]
+        for d in diag_mod.sort_diagnostics(diags):
+            lines.append(f"- `{d.query}` {d.code} [{d.path}]: "
+                         f"{d.message}")
+    pathlib.Path(args.md).write_text("\n".join(lines) + "\n")
+
+    print(f"mqo-audit: {meta['parts']} parts, "
+          f"{meta['subtrees_indexed']} subtrees indexed, "
+          f"{meta['shared_spine_candidates']} shared-spine candidate(s), "
+          f"{len(diags)} diagnostic(s) -> {args.json}")
+    if errors:
+        print(f"mqo-audit: {len(errors)} part(s) failed analysis: "
+              f"{sorted(errors)}", file=sys.stderr)
+
+    if args.write_baseline:
+        DEFAULT_BASELINE.write_text(diag_mod.baseline_dump(diags))
+        print(f"mqo-audit: baseline rewritten -> {DEFAULT_BASELINE}")
+
+    if args.baseline is not None:
+        bpath = pathlib.Path(args.baseline)
+        if not bpath.exists():
+            print(f"mqo-audit: baseline {bpath} missing "
+                  "(run --write-baseline)", file=sys.stderr)
+            return 2
+        accepted = diag_mod.baseline_load(bpath.read_text())
+        new = diag_mod.new_against_baseline(diags, accepted)
+        if new:
+            print(f"mqo-audit: {len(new)} diagnostic(s) not in baseline:",
+                  file=sys.stderr)
+            for d in new:
+                print(f"  {d.query} {d.code} [{d.path}]: {d.message}",
+                      file=sys.stderr)
+            return 1
+        print(f"mqo-audit: clean against baseline "
+              f"({len(accepted)} accepted)")
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_audit(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -63,18 +63,34 @@ def run_lint(args) -> int:
     subset = set(args.sub_queries.split(",")) if args.sub_queries else None
 
     diags, verdicts, fps = [], {}, {}
+    per_sites, subtree_counts = {}, {}
     for name, sql in streamgen.render_power_corpus(
             rngseed=args.rngseed, stream=args.stream):
         if subset is not None and name not in subset:
             continue
         res = analysis.analyze_sql(sess, name, sql, tables=tables,
-                                   scale_factor=args.scale_factor)
+                                   scale_factor=args.scale_factor,
+                                   spine_pass=True)
         verdicts[name] = res.verdict
         diags.extend(res.diagnostics)
         if res.canon is not None:
             fps[name] = {"fingerprint": res.canon.fingerprint,
                          "bindable": len(res.canon.bindable),
                          "shape": len(res.canon.shape_affecting)}
+        sites = res.spine_sites or []
+        per_sites[name] = sites
+        subtree_counts[name] = {
+            "candidates": len(sites),
+            "shareable": sum(1 for s in sites if s.shareable),
+            "eligible": len(analysis.spines.eligible_sites(sites)),
+        }
+
+    # cross-query pass: the spine index only exists over the whole
+    # sweep (NDS5xx diagnoses subtrees shared by >= 2 parts, so a
+    # subset run's diagnostic set stays a subset of the baseline)
+    spine_index, spine_diags = analysis.spines.build_index(per_sites)
+    diags.extend(spine_diags)
+    spine_summary = analysis.spines.index_to_doc(spine_index)["summary"]
 
     meta = {
         "rngseed": args.rngseed,
@@ -84,9 +100,11 @@ def run_lint(args) -> int:
         "device": sum(1 for v in verdicts.values() if v == "device"),
         "fallback": sorted(q for q, v in verdicts.items()
                            if v == "fallback"),
+        "spines": spine_summary,
     }
     pathlib.Path(args.json).write_text(
-        diag_mod.to_json(diags, dict(meta, canon_fingerprints=fps)))
+        diag_mod.to_json(diags, dict(meta, canon_fingerprints=fps,
+                                     subtree_counts=subtree_counts)))
     md = diag_mod.to_markdown(diags, meta)
     if fps:
         md += ("\n## Canonical fingerprints\n\n"
@@ -95,6 +113,15 @@ def run_lint(args) -> int:
         md += "".join(
             f"| {q} | `{e['fingerprint']}` | {e['bindable']} "
             f"| {e['shape']} |\n" for q, e in sorted(fps.items()))
+    if subtree_counts:
+        md += ("\n## Subtree spine candidates (full index: "
+               "MQO_AUDIT.json via scripts/mqo_audit.py)\n\n"
+               "| part | candidate subtrees | shareable | "
+               "eligible (outermost) |\n|---|---|---|---|\n")
+        md += "".join(
+            f"| {q} | {c['candidates']} | {c['shareable']} "
+            f"| {c['eligible']} |\n"
+            for q, c in sorted(subtree_counts.items()))
     pathlib.Path(args.md).write_text(md)
     print(f"plan-lint: {meta['parts']} parts, {meta['device']} device, "
           f"{len(meta['fallback'])} fallback, {len(diags)} diagnostics "

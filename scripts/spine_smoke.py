@@ -1,0 +1,117 @@
+"""Spine-sharing smoke: cached common spines must not change results.
+
+CI gate for the runtime spine cache (ndstpu/engine/spine.py +
+ndstpu/engine/session.py splicing): renders a tiny warehouse and TWO
+IDENTICAL query streams (throughput streams are normally
+param-divergent, so the second stream file is a byte-for-byte copy of
+the first — every spine value-key recurs by construction), runs the
+same in-process throughput invocation twice over a shared Session —
+once with sharing on (default), once under ``NDSTPU_SPINES=0`` — and
+asserts
+
+* both phases exit 0;
+* the sharing-on phase measured at least one ``engine.spine.hit``
+  (visible as ``extra.spine_hits`` on its ledger entries);
+* every query's result CSV is byte-identical between the two phases,
+  including row order — splicing a cached spine table may never
+  change what a query returns.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SUB_QUERIES = "query3,query52,query96"
+
+
+def run(cmd, **kw):
+    print("+", " ".join(map(str, cmd)), flush=True)
+    return subprocess.run([str(c) for c in cmd], **kw)
+
+
+def main() -> int:
+    root = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_spine_smoke"))
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    py = [sys.executable, "-m"]
+    run(py + ["ndstpu.datagen.driver", "local", "0.002", "2",
+              root / "raw"], check=True, env=env)
+    run(py + ["ndstpu.io.transcode", "--input_prefix", root / "raw",
+              "--output_prefix", root / "wh",
+              "--report_file", root / "load.txt",
+              "--output_format", "ndslake"],
+        check=True, env=env, stdout=subprocess.DEVNULL)
+    run(py + ["ndstpu.queries.streamgen", "--output_dir",
+              root / "streams", "--rngseed", "07291122510",
+              "--streams", "2"],
+        check=True, env=env, stdout=subprocess.DEVNULL)
+    # streams 1 and 2 must render IDENTICAL literals so every spine
+    # value-key occurs twice; stream 2 becomes a copy of stream 1
+    shutil.copyfile(root / "streams" / "query_1.sql",
+                    root / "streams" / "query_2.sql")
+
+    ledgers = {}
+    for phase, spines in (("on", "1"), ("off", "0")):
+        ledgers[phase] = root / f"ledger_{phase}.jsonl"
+        penv = dict(env, NDSTPU_SPINES=spines)
+        r = run(py + ["ndstpu.harness.throughput", "1,2",
+                      "--concurrent", "2", "--mode", "inproc",
+                      "--overlap_report", root / f"overlap_{phase}.json",
+                      "--",
+                      sys.executable, "-m", "ndstpu.harness.power",
+                      str(root / "streams") + "/query_{}.sql",
+                      root / "wh",
+                      str(root) + f"/time_{phase}_{{}}.csv",
+                      "--input_format", "ndslake",
+                      "--output_prefix",
+                      str(root) + f"/out_{phase}_{{}}",
+                      "--output_format", "csv",
+                      "--ledger", ledgers[phase],
+                      "--sub_queries", SUB_QUERIES],
+                env=penv)
+        assert r.returncode == 0, \
+            f"spines={spines} phase exited {r.returncode}"
+
+    # >= 1 measured engine.spine.hit: the splice path annotates the
+    # query span, and the inproc exporter copies spine_hits into the
+    # ledger entry's extra (ndstpu/harness/scheduler.py)
+    hits = bytes_saved = 0
+    for line in ledgers["on"].read_text().splitlines():
+        entry = json.loads(line)
+        extra = entry.get("extra") or {}
+        hits += extra.get("spine_hits") or 0
+        bytes_saved += extra.get("spine_bytes_saved") or 0
+    assert hits >= 1, \
+        "sharing-on phase recorded no engine.spine.hit in its ledger"
+    off_hits = sum(
+        (json.loads(line).get("extra") or {}).get("spine_hits") or 0
+        for line in ledgers["off"].read_text().splitlines())
+    assert off_hits == 0, \
+        f"NDSTPU_SPINES=0 phase still recorded {off_hits} spine hit(s)"
+
+    # byte-identical results, row order included
+    compared = 0
+    for sid in ("1", "2"):
+        for q in SUB_QUERIES.split(","):
+            a = root / f"out_on_{sid}" / q / "part-0.csv"
+            b = root / f"out_off_{sid}" / q / "part-0.csv"
+            assert a.exists() and b.exists(), \
+                f"missing result output for stream {sid} {q}"
+            assert a.read_bytes() == b.read_bytes(), \
+                (f"stream {sid} {q}: sharing-on result differs from "
+                 f"sharing-off ({a} vs {b})")
+            compared += 1
+    print(f"smoke OK: {hits} spine hit(s), "
+          f"{bytes_saved} bytes saved, {compared} result files "
+          "byte-identical with sharing off")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -249,8 +249,13 @@ def test_committed_artifacts_current(sess, tables):
     today for the queries it covers (spot-checked, not a full sweep —
     CI's plan-lint step does the full gate)."""
     obj = json.loads(open(os.path.join(REPO, "PLAN_LINT.json")).read())
+    # NDS5xx spine diagnostics are corpus-level (emitted by the
+    # cross-query index over the whole sweep, analysis/spines.py) — a
+    # single-query analysis cannot reproduce them, so scope the spot
+    # check to the per-query families
     want = sorted(d["code"] for d in obj["diagnostics"]
-                  if d["query"] == "query61")
+                  if d["query"] == "query61"
+                  and not d["code"].startswith("NDS5"))
     res = analyze(sess, tables, corpus_part("query61"))
     assert sorted(codes(res)) == want
 

@@ -1,0 +1,245 @@
+"""Common-spine sharing tests (analysis + runtime MQO).
+
+Analysis half (ndstpu/analysis/spines.py): every plan subtree gets a
+canonical fingerprint that is STABLE across corpus renderings — the
+same template under different seeds/streams maps to the same
+per-subtree fingerprints (literals are slot-lifted per subtree), which
+is what makes the cross-corpus spine index meaningful.
+
+Runtime half (ndstpu/engine/spine.py + Session._splice_spines): a
+query whose flagged spine is already cached splices the materialized
+table instead of recomputing — and the spliced run must be
+bit-identical to the recomputed run, row order included, on both the
+single-device and the SPMD backend.  The LRU cache never holds more
+than its byte budget, and NDSTPU_SPINES=0 disables sharing entirely.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from ndstpu import analysis, obs
+from ndstpu.analysis import spines as an_spines
+from ndstpu.engine import columnar
+from ndstpu.engine import spine as rt_spine
+from ndstpu.engine.session import Session
+from ndstpu.io import loader
+from ndstpu.queries import streamgen
+
+SEEDS = ("07291122510", "19980713042")
+STREAMS = (0, 1)
+PARTS = ("query3", "query7", "query52")
+
+
+def _render(rngseed, stream, wanted):
+    out = {}
+    for name, sql in streamgen.render_power_corpus(rngseed=rngseed,
+                                                   stream=stream):
+        if name in wanted:
+            out[name] = sql
+    return out
+
+
+# -- analysis: subtree fingerprints + the shared-spine index -----------------
+
+
+@pytest.fixture(scope="module")
+def schema_session():
+    return Session(analysis.schema_catalog())
+
+
+def test_subtree_fingerprints_stable_across_renderings(schema_session):
+    """Each part's {subtree path -> fingerprint} map is identical under
+    every seed x stream rendering: per-subtree slot-lifting removes the
+    literals, so only the template's structure is fingerprinted."""
+    tables = analysis.schema_tables()
+    maps = {}  # part -> {combo: {path: fingerprint}}
+    for seed in SEEDS:
+        for stream in STREAMS:
+            rendered = _render(seed, stream, set(PARTS))
+            assert set(rendered) == set(PARTS)
+            for name, sql in rendered.items():
+                plan, _ = schema_session.plan(sql)
+                subs = analysis.canonicalize_subtrees(plan, tables=tables,
+                                                      query=name)
+                fp = {s.path: s.canon.fingerprint for s in subs
+                      if s.canon is not None}
+                assert fp, f"{name}: no canonicalizable subtrees"
+                maps.setdefault(name, {})[(seed, stream)] = fp
+    for name, by_combo in maps.items():
+        combos = list(by_combo.values())
+        for other in combos[1:]:
+            assert other == combos[0], \
+                f"{name}: subtree fingerprints vary across renderings"
+
+
+def test_shared_spine_index_and_diagnostics(schema_session):
+    """query1/query7 share a canonical subtree with different literal
+    bindings: the index reports it shareable across both parts and the
+    diagnostics carry NDS501 (+ NDS502 for the divergent params)."""
+    from ndstpu.analysis import diagnostics as diag_mod
+    for code in ("NDS501", "NDS502", "NDS503", "NDS504"):
+        assert code in diag_mod.CODES  # registered, not ad-hoc
+    tables = analysis.schema_tables()
+    per_sites = {}
+    for name, sql in _render(SEEDS[0], 0, {"query1", "query7"}).items():
+        res = analysis.analyze_sql(schema_session, name, sql,
+                                   tables=tables, spine_pass=True)
+        per_sites[name] = res.spine_sites or []
+        assert res.spine_sites, f"{name}: spine pass found no sites"
+    index, diags = an_spines.build_index(per_sites)
+    shared = [rec for rec in index.values()
+              if len(rec["queries"]) >= 2 and rec["shareable"]]
+    assert shared, "query1/query7 lost their shared spine"
+    codes = {d.code for d in diags}
+    assert "NDS501" in codes
+    assert "NDS502" in codes  # different literals -> param-divergent
+    doc = an_spines.index_to_doc(index)
+    assert doc["summary"]["shared_spine_candidates"] >= 1
+    # eligibility: outermost only — no selected site may contain another
+    for name, sites in per_sites.items():
+        chosen = an_spines.eligible_sites(sites)
+        paths = [s.path for s in chosen]
+        for p in paths:
+            assert not any(q != p and q.startswith(p + "/")
+                           for q in paths), \
+                f"{name}: nested eligible sites {paths}"
+
+
+# -- runtime: LRU byte budget ------------------------------------------------
+
+
+def _table(n_rows: int) -> columnar.Table:
+    return columnar.Table({"v": columnar.Column.from_numpy(
+        np.arange(n_rows, dtype=np.int64), columnar.INT64)})
+
+
+def test_spine_cache_eviction_never_exceeds_budget():
+    one = rt_spine.table_bytes(_table(100))  # 800 B
+    cache = rt_spine.SpineCache(budget_bytes=2 * one)
+    assert cache.eligible("anything")  # flagged=None -> publish all
+    state = ("epoch", ())
+    for i in range(5):
+        assert cache.put(f"vk{i}", state, _table(100))
+        assert cache.total_bytes <= cache.budget_bytes
+    assert len(cache) == 2
+    assert cache.evictions == 3
+    # LRU order: the two most recent survive
+    assert cache.get("vk4", state) is not None
+    assert cache.get("vk0", state) is None
+    # a table bigger than the whole budget is refused, not force-fit
+    assert not cache.put("huge", state, _table(1000))
+    assert cache.total_bytes <= cache.budget_bytes
+    # stale state drops the entry instead of serving it
+    assert cache.get("vk4", ("epoch2", ())) is None
+    assert "vk4" not in cache._entries
+
+
+def test_replace_nodes_is_non_mutating(schema_session):
+    plan, _ = schema_session.plan(
+        "select i_item_sk from item where i_item_sk < 10")
+    target = plan
+    while getattr(target, "child", None) is not None:
+        target = target.child
+    inline = columnar.Table({"i_item_sk": columnar.Column.from_numpy(
+        np.arange(3, dtype=np.int64), columnar.INT64)})
+    from ndstpu.engine import plan as lp
+    spliced = rt_spine.replace_nodes(
+        plan, {id(target): lp.InlineTable(inline, name="spine:test")})
+    assert spliced is not plan
+    # the shared cached plan keeps its original node
+    t = plan
+    while getattr(t, "child", None) is not None:
+        t = t.child
+    assert not isinstance(t, lp.InlineTable)
+    t = spliced
+    while getattr(t, "child", None) is not None:
+        t = t.child
+    assert isinstance(t, lp.InlineTable)
+
+
+# -- runtime: splice vs recompute over a real warehouse ----------------------
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    root = tmp_path_factory.mktemp("nds_spine")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    subprocess.run(["python", "-m", "ndstpu.datagen.driver", "local",
+                    "0.002", "2", str(root / "raw")], check=True, env=env)
+    subprocess.run(["python", "-m", "ndstpu.io.transcode",
+                    "--input_prefix", str(root / "raw"),
+                    "--output_prefix", str(root / "wh"),
+                    "--report_file", str(root / "load.txt"),
+                    "--output_format", "ndslake"],
+                   check=True, env=env, stdout=subprocess.DEVNULL)
+    return root
+
+
+@pytest.fixture(scope="module")
+def spine_queries():
+    return _render(SEEDS[0], 0, {"query3", "query52"})
+
+
+def _run_differential(dataset, spine_queries, backend):
+    """Same queries on a plain session and on a spine-cached session
+    (run twice: first populates, second must hit); all three result
+    sets must be byte-identical including row order."""
+    import pyarrow  # noqa: F401 — to_arrow comparison below
+
+    catalog = loader.load_catalog(str(dataset / "wh"))
+    plain = Session(catalog, backend=backend)
+    shared = Session(catalog, backend=backend)
+    shared.spine_cache = rt_spine.SpineCache(64 << 20)  # flag everything
+
+    before = obs.counters_snapshot()
+    for name, sql in spine_queries.items():
+        baseline = plain.sql(sql)
+        first = shared.sql(sql)
+        second = shared.sql(sql)
+        for tag, got in (("first", first), ("second", second)):
+            a, b = columnar.to_arrow(baseline), columnar.to_arrow(got)
+            assert a.equals(b), \
+                f"{backend} {name}: {tag} spliced run differs"
+    delta = obs.counter_delta(before)
+    assert shared.spine_cache.hits >= len(spine_queries), \
+        f"{backend}: repeated queries did not hit the spine cache"
+    assert delta.get("engine.spine.hit", 0) >= len(spine_queries)
+    assert delta.get("engine.spine.miss", 0) >= 1
+    assert shared.spine_cache.total_bytes <= \
+        shared.spine_cache.budget_bytes
+
+
+def test_splice_vs_recompute_identical_single_device(dataset,
+                                                     spine_queries):
+    _run_differential(dataset, spine_queries, "tpu")
+
+
+def test_splice_vs_recompute_identical_spmd(dataset, spine_queries):
+    # conftest pins an 8-device virtual CPU mesh; tpu-spmd distributes
+    # (or per-query falls back) over it — either way results must match
+    _run_differential(dataset, spine_queries, "tpu-spmd")
+
+
+def test_kill_switch_disables_sharing(dataset, spine_queries,
+                                      monkeypatch):
+    name, sql = next(iter(spine_queries.items()))
+    catalog = loader.load_catalog(str(dataset / "wh"))
+    on = Session(catalog, backend="cpu")
+    on.spine_cache = rt_spine.SpineCache(64 << 20)
+    expected = columnar.to_arrow(on.sql(sql))
+
+    monkeypatch.setenv("NDSTPU_SPINES", "0")
+    off = Session(catalog, backend="cpu")
+    off.spine_cache = rt_spine.SpineCache(64 << 20)
+    for _ in range(2):
+        got = columnar.to_arrow(off.sql(sql))
+        assert expected.equals(got)
+    assert off.spine_cache.hits == 0
+    assert off.spine_cache.misses == 0
+    assert len(off.spine_cache) == 0  # nothing published either
+    assert not rt_spine.enabled()
+    monkeypatch.delenv("NDSTPU_SPINES")
+    assert rt_spine.enabled()
