@@ -36,6 +36,31 @@ class ChunkFallthroughError(RuntimeError):
 
 
 @dataclass
+class SnapshotPin:
+    """An immutable catalog epoch captured at query admission
+    (docs/ARCHITECTURE.md "Snapshot-pinned reads").
+
+    Catalog entries are REPLACED, never mutated, by DML and register
+    (io/loader.Catalog), so shallow-copied dicts are a frozen,
+    consistent view: a query planned and executed against this pin
+    sees exactly the snapshot that existed when the pin was taken,
+    however many refresh functions commit meanwhile.  ``epoch`` is the
+    durable data-version identity (io/lake.warehouse_epoch when a
+    warehouse is attached) the ingest differential keys results on."""
+
+    catalog: object
+    views: Dict[str, lp.Plan]
+    views_epoch: int
+    versions: tuple  # sorted (table, version) catalog-version vector
+    epoch: Optional[str] = None
+
+    @property
+    def state(self):
+        """Cache-state tuple, same shape the live caches key on."""
+        return (self.views_epoch, self.versions)
+
+
+@dataclass
 class Session:
     catalog: object  # ndstpu.io.loader.Catalog
     views: Dict[str, lp.Plan] = field(default_factory=dict)
@@ -108,14 +133,54 @@ class Session:
         self._plan_latch = KeyedLatch()
         self._plan_cache: Dict[str, tuple] = {}
 
-    def sql(self, text: str) -> Optional[columnar.Table]:
-        """Execute one statement; returns a Table for queries, None for DDL."""
+    def sql(self, text: str,
+            pin: Optional[SnapshotPin] = None) -> Optional[columnar.Table]:
+        """Execute one statement; returns a Table for queries, None for
+        DDL.  With ``pin`` (from :meth:`pin_snapshot`), a query runs
+        against that frozen catalog epoch regardless of concurrent
+        ingest commits — DML/DDL under a pin is an error."""
         from ndstpu.engine.sql import normalize_sql_key
         stmt = parse_statement(text)
-        return self._run(stmt, key=normalize_sql_key(text))
+        return self._run(stmt, key=normalize_sql_key(text), pin=pin)
 
     def sql_script(self, text: str) -> List[Optional[columnar.Table]]:
         return [self._run(s) for s in parse_statements(text)]
+
+    def pin_snapshot(self) -> SnapshotPin:
+        """Resolve and freeze the current catalog epoch for a query's
+        lifetime.  Taken under the execution lock — the micro-batch
+        ingestor (harness/ingest.py) holds the same lock across each
+        whole refresh function, so a pin can only ever observe batch
+        boundaries, never half a refresh function."""
+        from ndstpu import obs
+        with self._exec_lock:
+            from ndstpu.io.loader import Catalog
+            cat = Catalog(tables=dict(self.catalog.tables),
+                          meta=dict(getattr(self.catalog, "meta", {})),
+                          versions=dict(
+                              getattr(self.catalog, "versions", {})))
+            pin = SnapshotPin(
+                catalog=cat, views=dict(self.views),
+                views_epoch=self._views_epoch,
+                versions=tuple(sorted(cat.versions.items())),
+                epoch=self.snapshot_epoch())
+        obs.inc("engine.snapshot.pinned")
+        return pin
+
+    def snapshot_epoch(self) -> Optional[str]:
+        """Durable data-version identity of this session's data: the
+        lake warehouse epoch (io/lake.py) when a warehouse is attached,
+        else a local tag over the in-memory catalog-version vector."""
+        if self.warehouse is not None:
+            from ndstpu.io import lake
+            ep = lake.warehouse_epoch(self.warehouse)
+            if ep is not None:
+                return ep
+        import hashlib
+        versions = tuple(sorted(
+            getattr(self.catalog, "versions", {}).items()))
+        blob = repr((self._views_epoch, versions)).encode()
+        return "mem" + hashlib.sha256(blob).hexdigest()[:12]
 
     def plan(self, text: str):
         stmt = parse_statement(text)
@@ -126,8 +191,9 @@ class Session:
         from ndstpu.engine.optimizer import optimize
         return optimize(plan, self.catalog), cols
 
-    def _run(self, stmt: ast.Node,
-             key: Optional[str] = None) -> Optional[columnar.Table]:
+    def _run(self, stmt: ast.Node, key: Optional[str] = None,
+             pin: Optional[SnapshotPin] = None
+             ) -> Optional[columnar.Table]:
         # the whole statement is execute_s; cold-path work nested inside
         # (discovery, jit builds) carries its own compile_s bucket and
         # is subtracted by the tracer's self-time accounting, so the
@@ -135,13 +201,14 @@ class Session:
         from ndstpu import obs
         with obs.span("statement", cat="plan-node", bucket="execute_s",
                       kind=type(stmt).__name__, backend=self.backend):
-            return self._run_traced(stmt, key)
+            return self._run_traced(stmt, key, pin)
 
     def _run_traced(self, stmt: ast.Node,
-                    key: Optional[str] = None
+                    key: Optional[str] = None,
+                    pin: Optional[SnapshotPin] = None
                     ) -> Optional[columnar.Table]:
         if isinstance(stmt, ast.Query):
-            plan, disp, canon = self._plan_cached(stmt, key)
+            plan, disp, canon = self._plan_cached(stmt, key, pin)
             if canon is not None:
                 # canonical identity on the query span: sidecars and the
                 # run ledger can group renderings by structure
@@ -156,13 +223,19 @@ class Session:
             # statements, and one device runs programs serially anyway
             with self._exec_lock:
                 if getattr(self, "spine_cache", None) is not None:
-                    plan, canon = self._splice_spines(plan, canon, key)
-                out = self._execute(plan, key=key, canon=canon)
+                    plan, canon = self._splice_spines(plan, canon, key,
+                                                      pin)
+                out = self._execute(plan, key=key, canon=canon, pin=pin)
             return columnar.Table(dict(zip(disp, out.columns.values())))
+        if pin is not None:
+            raise ValueError(
+                "DDL/DML cannot run against a snapshot pin — pins are "
+                "read-only views of a committed epoch")
         with self._exec_lock:
             return self._run_ddl(stmt)
 
-    def _plan_cached(self, stmt: "ast.Query", key: Optional[str]):
+    def _plan_cached(self, stmt: "ast.Query", key: Optional[str],
+                     pin: Optional[SnapshotPin] = None):
         """Plan + optimize + canonicalize with the text-keyed plan
         cache; returns ``(plan, display_names, CanonResult-or-None)``.
 
@@ -176,6 +249,12 @@ class Session:
         distinct text exactly once: later arrivals block, then hit.
         Planning itself is host-pure (reads catalog/views), so distinct
         texts plan concurrently while the device executes.
+
+        A pinned query plans against the pin's frozen catalog/views and
+        keys the cache on the pin's state — a pin that fell behind the
+        live epoch replaces the entry and vice versa (thrash, never a
+        wrong plan), while a pin still AT the live epoch (the common
+        case between refresh batches) shares the live entry.
         """
         from ndstpu import faults, obs
         faults.check("plan", key=key)
@@ -187,13 +266,16 @@ class Session:
                     pc = self._plan_cache = {}
         if key is None:
             with obs.span("plan", cat="plan-node"):
-                plan, disp = self._plan_fresh(stmt)
+                plan, disp = self._plan_fresh(stmt, pin)
             return plan, disp, None
         latch = getattr(self, "_plan_latch", None)
         with (latch.holding(key) if latch is not None else _NULL_CM):
-            versions = tuple(sorted(
-                getattr(self.catalog, "versions", {}).items()))
-            state = (self._views_epoch, versions)
+            if pin is not None:
+                state = pin.state
+            else:
+                versions = tuple(sorted(
+                    getattr(self.catalog, "versions", {}).items()))
+                state = (self._views_epoch, versions)
             with getattr(self, "_cache_lock", _NULL_CM):
                 ent = pc.get(key)
             if ent is not None and ent[0] != state:
@@ -204,7 +286,7 @@ class Session:
                 _s, plan, disp, canon = ent
                 return plan, disp, canon
             with obs.span("plan", cat="plan-node"):
-                plan, disp = self._plan_fresh(stmt)
+                plan, disp = self._plan_fresh(stmt, pin)
             canon = self._canonicalize(plan, key)
             # store only on success: a planner exception propagates
             # with nothing cached (no poisoning), the latch releases
@@ -272,7 +354,8 @@ class Session:
         except Exception:  # noqa: BLE001 — unplannable text
             return set()
 
-    def _splice_spines(self, plan: lp.Plan, canon, key: Optional[str]):
+    def _splice_spines(self, plan: lp.Plan, canon, key: Optional[str],
+                       pin: Optional[SnapshotPin] = None):
         """Replace this plan's flagged spine subtrees with their
         materialized tables (InlineTable), publishing on first use.
 
@@ -300,9 +383,16 @@ class Session:
             return plan, canon
         if not sites:
             return plan, canon
-        versions = tuple(sorted(
-            getattr(self.catalog, "versions", {}).items()))
-        state = (self._views_epoch, versions)
+        if pin is not None:
+            # spine entries are keyed to the PIN's epoch: a query
+            # pinned before an ingest commit neither serves nor is
+            # served a post-commit spine (the cache's state check
+            # drops the mismatch and ticks engine.snapshot.stale_drops)
+            state = pin.state
+        else:
+            versions = tuple(sorted(
+                getattr(self.catalog, "versions", {}).items()))
+            state = (self._views_epoch, versions)
         memo = getattr(self, "_spine_splice_memo", None)
         if memo is None:
             memo = self._spine_splice_memo = {}
@@ -320,7 +410,7 @@ class Session:
                     cache.misses += 1
                     # materialize the subtree standalone; exceptions
                     # propagate as this query's failure
-                    t = self._execute(site.node)
+                    t = self._execute(site.node, pin=pin)
                     cache.put(vk, state, t)
                 else:
                     hits += 1
@@ -355,11 +445,14 @@ class Session:
         memo[mk] = (new_plan, canon2)
         return new_plan, canon2
 
-    def _plan_fresh(self, stmt: "ast.Query"):
-        planner = pl.Planner(self.catalog, dict(self.views))
+    def _plan_fresh(self, stmt: "ast.Query",
+                    pin: Optional[SnapshotPin] = None):
+        cat = self.catalog if pin is None else pin.catalog
+        views = self.views if pin is None else pin.views
+        planner = pl.Planner(cat, dict(views))
         plan, cols = planner.plan_query(stmt)
         from ndstpu.engine.optimizer import optimize
-        plan = optimize(plan, self.catalog)
+        plan = optimize(plan, cat)
         # display names: strip alias qualifiers
         disp = self._dedupe(planner._display_names(cols))
         return plan, disp
@@ -405,9 +498,19 @@ class Session:
         return out
 
     def _execute(self, plan: lp.Plan, key: Optional[str] = None,
-                 canon=None) -> columnar.Table:
+                 canon=None,
+                 pin: Optional[SnapshotPin] = None) -> columnar.Table:
         from ndstpu import faults
         faults.check("execute", key=key)
+        if pin is not None and not self._pin_matches_live(pin):
+            # the catalog advanced past this pin (ingest committed
+            # between admission and execution): run against the pinned
+            # snapshot directly on the host engine.  Device-side caches
+            # are keyed to live state, so a stale pin trades device
+            # speed for snapshot isolation — the robustness-over-perf
+            # choice; the common case (pin == live epoch) stays on the
+            # normal backend path below.
+            return physical.execute(plan, pin.catalog)
         # single-chip out-of-core: when chunk_rows is set, the `tpu`
         # backend streams facts through the SAME chunked executor as
         # tpu-spmd, just over a 1-device mesh (SF >> HBM on one chip;
@@ -506,6 +609,12 @@ class Session:
                     plan, f"{self._views_epoch}|{key}")
             return exe.execute_to_host(plan)
         return physical.execute(plan, self.catalog)
+
+    def _pin_matches_live(self, pin: SnapshotPin) -> bool:
+        versions = tuple(sorted(
+            getattr(self.catalog, "versions", {}).items()))
+        return pin.views_epoch == self._views_epoch \
+            and pin.versions == versions
 
     def _note_chunk_fallthrough(self, u: Exception) -> None:
         """NDS311: out-of-core streaming was configured on a multi-device

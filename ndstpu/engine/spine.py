@@ -113,7 +113,11 @@ class SpineCache:
             if ent is None:
                 return None
             if ent[0] != state:
+                # the catalog epoch moved under this entry (ingest
+                # commit / DML / view churn): a hit here would serve a
+                # pre-ingest spine to a post-ingest query
                 self._drop(value_key)
+                _obs_inc("engine.snapshot.stale_drops")
                 return None
             self._entries.move_to_end(value_key)
             return ent[1]

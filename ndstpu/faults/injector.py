@@ -15,6 +15,8 @@ site                   probe location
 ``exchange.collective``SPMD shuffle/broadcast/psum trace sites
 ``stream.worker``      in-process throughput stream worker entry
 ``phase.subprocess``   bench driver phase subprocess launch
+``ingest.commit``      lake CAS commit publish (io/acid, io/deltalog)
+``ingest.apply``       micro-batch ingest apply (harness/ingest)
 =====================  ====================================================
 
 A spec is a comma-separated rule list::
@@ -47,7 +49,7 @@ from ndstpu import obs
 
 SITES = ("plan", "compile", "execute", "io.write", "io.read",
          "io.prefetch", "exchange.collective", "stream.worker",
-         "phase.subprocess")
+         "phase.subprocess", "ingest.commit", "ingest.apply")
 
 KINDS = ("transient", "permanent", "hang")
 

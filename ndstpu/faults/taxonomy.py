@@ -29,6 +29,8 @@ PERMANENT = "permanent"
 # exception class names (matched along the MRO) that are retry-worthy
 TRANSIENT_TYPE_NAMES = frozenset({
     "InjectedTransient",
+    # losing a lake commit race (io/commit.py): reload + rebase + retry
+    "CommitConflict",
     "TimeoutError",
     "ConnectionError",
     "ConnectionResetError",

@@ -87,6 +87,12 @@ def run_query(args) -> None:
     sess = Session(catalog, warehouse=args.warehouse_path)
     register_staging_views(sess, args.refresh_data_path)
 
+    # journal per-table pre-maintenance snapshot versions so rollback
+    # can target exact versions instead of an ambiguous timestamp when
+    # micro-batches commit sub-second apart (harness/rollback.py)
+    from ndstpu.harness import rollback as rollback_mod
+    rollback_mod.record_pre_maintenance_versions(args.warehouse_path)
+
     queries = get_maintenance_queries(sess, DM_FUNCS)
     if args.dm_funcs:
         keep = args.dm_funcs.split(",")
@@ -95,11 +101,24 @@ def run_query(args) -> None:
             raise ValueError(f"unknown DM functions {missing}")
         queries = {f: queries[f] for f in keep}
 
+    ing = None
+    if getattr(args, "micro_batch", False):
+        # crash-consistent mode: each refresh function becomes one
+        # journaled micro-batch (intent/done + restore-and-retry on
+        # transient faults — harness/ingest.py)
+        from ndstpu.harness.ingest import MicroBatchIngestor
+        ing = MicroBatchIngestor(args.warehouse_path, sess=sess)
+
     start = time.time()
     for fn, stmts in queries.items():
         print(f"====== Run {fn} ======")
         rpt = BenchReport({"warehouse": args.warehouse_path})
-        summary = rpt.report_on(run_dm_query, sess, stmts)
+        if ing is not None:
+            def _apply(stmts=stmts):
+                run_dm_query(sess, stmts)
+            summary = rpt.report_on(ing.apply_batch, fn, _apply)
+        else:
+            summary = rpt.report_on(run_dm_query, sess, stmts)
         print(f"Time taken: {summary['queryTimes']} millis for {fn}")
         execution_times.append((app_id, fn, summary["queryTimes"][0]))
         if args.json_summary_folder:
@@ -132,6 +151,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dm_funcs",
                    help="comma-separated subset of DM functions, e.g. "
                         "LF_SS,DF_SS")
+    p.add_argument("--micro_batch", action="store_true",
+                   help="apply each refresh function as one journaled "
+                        "crash-consistent micro-batch "
+                        "(harness/ingest.py)")
     p.add_argument("--json_summary_folder")
     return p
 

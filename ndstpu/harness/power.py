@@ -742,9 +742,21 @@ def run_query_stream(args) -> None:
                  if q["query"] in set(executed)]
         if led is not None and qsums:
             try:
+                # data-version identity of the warehouse this run read:
+                # the sentinel only compares warm walls within one
+                # epoch (verdict data-changed across epochs), and rows
+                # appended here carry the stamp for future runs
+                run_epoch = None
+                try:
+                    from ndstpu.io import lake as lake_mod
+                    run_epoch = lake_mod.warehouse_epoch(
+                        args.input_prefix)
+                except Exception:  # noqa: BLE001 — stamp is best-effort
+                    pass
                 sentinel_block = sentinel.classify_run(
                     qsums, led, engine=args.engine,
-                    scale_factor=run_scale_factor)
+                    scale_factor=run_scale_factor,
+                    snapshot_epoch=run_epoch)
                 entries = [ledger_mod.make_entry(
                     q["query"], q["wall_s"], q["compile_s"],
                     q["execute_s"], engine=args.engine,
@@ -756,6 +768,7 @@ def run_query_stream(args) -> None:
                     # attributable per stream
                     extra={k: v for k, v in {
                         "stream": stream_name,
+                        "snapshot_epoch": run_epoch,
                         "fallback_codes":
                             (q.get("attrs") or {}).get("fallback_codes"),
                         "spmd_fallback":

@@ -5,6 +5,16 @@ to undo data maintenance between repeated benchmark runs
 (/root/reference/nds/nds_rollback.py:37-59).  Here the same operation runs
 against either ACID format: ndslake (snapshot manifests, Iceberg analog)
 or ndsdelta (transaction log RESTORE, Delta analog).
+
+Version-first rollback: the maintenance runner journals each table's
+pre-maintenance snapshot VERSION before its first refresh function
+(``_maintenance/PRE_DM_VERSIONS.jsonl``, written via
+io/atomic.append_jsonl).  When that journal has a record at-or-before
+the requested timestamp, rollback targets the recorded versions —
+timestamp rollback is ambiguous when micro-batches commit sub-second
+apart (two commits can share a clock tick, and the "newest snapshot
+<= ts" rule then picks whichever sorted later).  Timestamp remains the
+fallback for tables or warehouses with no recorded versions.
 """
 
 from __future__ import annotations
@@ -12,12 +22,39 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import Dict
+import time
+from typing import Dict, Optional
 
-from ndstpu.io import lake
+from ndstpu.io import atomic, lake
 
 FACT_TABLES = ["store_sales", "store_returns", "catalog_sales",
                "catalog_returns", "web_sales", "web_returns", "inventory"]
+
+SNAPSHOT_JOURNAL_RELPATH = os.path.join("_maintenance",
+                                        "PRE_DM_VERSIONS.jsonl")
+
+
+def record_pre_maintenance_versions(warehouse: str) -> Optional[dict]:
+    """Journal every lake table's CURRENT version before maintenance
+    writes begin (called by harness/maintenance.py).  Returns the
+    record, or None when the warehouse has no lake tables."""
+    vec = lake.versions_vector(warehouse)
+    if not vec:
+        return None
+    rec = {"ts": round(time.time(), 3), "versions": vec}
+    atomic.append_jsonl(
+        os.path.join(warehouse, SNAPSHOT_JOURNAL_RELPATH), rec)
+    return rec
+
+
+def recorded_versions_at(warehouse: str, ts: float) -> Optional[dict]:
+    """The newest journaled pre-maintenance record at-or-before ``ts``,
+    or None."""
+    recs = [r for r in atomic.read_jsonl(
+                os.path.join(warehouse, SNAPSHOT_JOURNAL_RELPATH))
+            if isinstance(r.get("versions"), dict)
+            and isinstance(r.get("ts"), (int, float)) and r["ts"] <= ts]
+    return max(recs, key=lambda r: r["ts"]) if recs else None
 
 
 def rollback(warehouse: str, timestamp: float,
@@ -26,6 +63,8 @@ def rollback(warehouse: str, timestamp: float,
     abort the remaining ones.  Returns ``{table: error}`` for the
     failures — the CLI exits nonzero if any, since a benchmark rerun
     against a half-rolled-back warehouse measures garbage."""
+    rec = recorded_versions_at(warehouse, timestamp)
+    recorded = (rec or {}).get("versions") or {}
     failures: Dict[str, str] = {}
     for table in tables or FACT_TABLES:
         root = os.path.join(warehouse, table)
@@ -33,12 +72,19 @@ def rollback(warehouse: str, timestamp: float,
             print(f"skip {table}: not an ACID (ndslake/ndsdelta) table")
             continue
         try:
-            v = lake.rollback_to_timestamp(root, timestamp)
+            if table in recorded:
+                v = lake.rollback_to_version(root, recorded[table])
+                print(f"rolled back {table} to recorded "
+                      f"pre-maintenance v{recorded[table]} "
+                      f"(new snapshot v{v})")
+            else:
+                v = lake.rollback_to_timestamp(root, timestamp)
+                print(f"rolled back {table} to snapshot v{v} "
+                      f"(timestamp fallback)")
         except Exception as e:  # noqa: BLE001 — keep rolling the rest
             failures[table] = f"{type(e).__name__}: {e}"
             print(f"ERROR: rollback of {table} failed: {failures[table]}")
             continue
-        print(f"rolled back {table} to snapshot v{v}")
     return failures
 
 

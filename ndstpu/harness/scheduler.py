@@ -595,8 +595,17 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
     ledger_block = None
     if led is not None and qsums:
         try:
+            # same epoch scoping as the power path (obs/sentinel.py):
+            # baselines never cross a data-version change
+            run_epoch = None
+            try:
+                from ndstpu.io import lake as lake_mod
+                run_epoch = lake_mod.warehouse_epoch(ns0.input_prefix)
+            except Exception:  # noqa: BLE001 — stamp is best-effort
+                pass
             sentinel_block = sentinel.classify_run(
-                qsums, led, engine=engine, scale_factor=scale_factor)
+                qsums, led, engine=engine, scale_factor=scale_factor,
+                snapshot_epoch=run_epoch)
             entries = [ledger_mod.make_entry(
                 q["query"], q["wall_s"], q["compile_s"],
                 q["execute_s"], engine=engine,
@@ -605,6 +614,7 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
                 extra={k: v for k, v in {
                     "stream": (q.get("attrs") or {}).get("stream"),
                     "mode": "inproc",
+                    "snapshot_epoch": run_epoch,
                     "fallback_codes":
                         (q.get("attrs") or {}).get("fallback_codes"),
                     "spmd_fallback":

@@ -20,9 +20,15 @@ Semantics:
     (undoes maintenance writes exactly like the reference's
     `CALL spark_catalog.system.rollback_to_timestamp`)
 
-Writers are single-process per table (the benchmark's DM phase runs one
-maintenance stream per table family), so CURRENT is updated by atomic
-rename.
+Commit protocol (docs/ROBUSTNESS.md "Ingest commit protocol"): CURRENT
+advances by a journaled compare-and-swap under the table's commit lock
+(io/commit.py) — every writer states the version its write is based on
+and loses with a typed, retryable `CommitConflict` (transient in the
+faults taxonomy) when another writer got there first.  The manifest is
+fully written and fsynced before the single atomic CURRENT publish, so
+a SIGKILL anywhere mid-commit leaves the old or the new snapshot
+current, never a torn pointer; `faults.check("ingest.commit")` probes
+exactly that window.
 """
 
 from __future__ import annotations
@@ -37,6 +43,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
+
+from ndstpu.io import commit as commit_proto
 
 
 @dataclass
@@ -61,20 +69,49 @@ def is_ndslake(table_dir: str) -> bool:
     return os.path.isdir(_meta_dir(table_dir))
 
 
-def _write_snapshot(table_dir: str, snap: Snapshot) -> None:
-    os.makedirs(_meta_dir(table_dir), exist_ok=True)
-    with open(_snap_path(table_dir, snap.version), "w") as f:
-        json.dump({
+def _commit_snapshot(table_dir: str, files: List[Dict],
+                     partition_col: Optional[str], operation: str,
+                     expected_version: Optional[int]) -> Snapshot:
+    """Journaled compare-and-swap commit.  Under the table's commit
+    lock: verify CURRENT still points at ``expected_version`` (None =
+    the table must not exist yet), allocate the next monotonic
+    version, durably write the manifest, journal the commit, then
+    atomically swing CURRENT.  The loser of a race gets
+    ``CommitConflict`` (transient: reload + rebase + retry); a SIGKILL
+    anywhere in here leaves the old or the new snapshot current —
+    the manifest/journal written before a crash are orphans, never a
+    torn pointer."""
+    from ndstpu import faults, obs
+    from ndstpu.io import atomic
+    md = _meta_dir(table_dir)
+    os.makedirs(md, exist_ok=True)
+    with commit_proto.commit_lock(md):
+        found = current_version(table_dir) \
+            if os.path.exists(os.path.join(md, "CURRENT")) else None
+        if found != expected_version:
+            obs.inc("engine.ingest.conflicts")
+            raise commit_proto.CommitConflict(
+                table_dir, expected_version, found)
+        snap = Snapshot(_next_version(table_dir), time.time(), files,
+                        partition_col, operation)
+        atomic.atomic_write_json(_snap_path(table_dir, snap.version), {
             "version": snap.version,
             "timestamp": snap.timestamp,
             "files": snap.files,
             "partition_col": snap.partition_col,
             "operation": snap.operation,
-        }, f, indent=1)
-    tmp = os.path.join(_meta_dir(table_dir), f".CURRENT.{uuid.uuid4().hex}")
-    with open(tmp, "w") as f:
-        f.write(str(snap.version))
-    os.replace(tmp, os.path.join(_meta_dir(table_dir), "CURRENT"))
+        }, indent=1)
+        commit_proto.journal(md, {
+            "version": snap.version, "prev": expected_version,
+            "operation": operation, "ts": round(snap.timestamp, 3)})
+        # the crash-mid-commit probe: a fault injected here fires after
+        # the manifest+journal exist but before CURRENT moves, exactly
+        # the window the atomicity guarantee covers
+        faults.check("ingest.commit", key=table_dir)
+        atomic.atomic_write_text(
+            os.path.join(md, "CURRENT"), str(snap.version))
+        obs.inc("engine.ingest.commits")
+    return snap
 
 
 def current_version(table_dir: str) -> int:
@@ -89,6 +126,65 @@ def _next_version(table_dir: str) -> int:
     vs = [int(n[1:9]) for n in os.listdir(_meta_dir(table_dir))
           if n.startswith("v") and n.endswith(".json")]
     return max(vs) + 1 if vs else 0
+
+
+def abort_to_version(table_dir: str, version: int) -> int:
+    """Crash-recovery retraction: point CURRENT back at ``version`` and
+    physically remove every snapshot manifest above it.  Unlike
+    :func:`rollback_to_version` (which publishes a NEW snapshot and
+    keeps history linear — the user-facing time-travel path), this
+    rewrites history, so it is only sound when no reader can hold the
+    retracted versions: recovering a micro-batch whose journal intent
+    never reached done (harness/ingest.py), before query serving
+    resumes.  Pins taken before the batch reference versions <= the
+    recorded pre-version and are untouched.  CURRENT swings first, then
+    the manifests unlink, so a crash mid-abort leaves a valid pointer
+    plus orphans a re-run GCs.  Retracted data files stay on disk —
+    unreachable garbage, never corruption."""
+    from ndstpu.io import atomic
+    md = _meta_dir(table_dir)
+    with commit_proto.commit_lock(md):
+        load_snapshot(table_dir, version)  # target must exist
+        retract = [int(n[1:9]) for n in os.listdir(md)
+                   if n.startswith("v") and n.endswith(".json")
+                   and int(n[1:9]) > version]
+        atomic.atomic_write_text(
+            os.path.join(md, "CURRENT"), str(version))
+        for v in sorted(retract):
+            os.unlink(_snap_path(table_dir, v))
+        if retract:
+            commit_proto.journal(md, {
+                "operation": f"abort_to(v{version})",
+                "retracted": sorted(retract),
+                "ts": round(time.time(), 3)})
+    return version
+
+
+def gc_orphan_manifests(table_dir: str) -> List[int]:
+    """Remove snapshot manifests that were written but never published
+    to CURRENT (a crash or injected fault between manifest write and
+    pointer swing).  No reader can hold one — pins resolve through
+    CURRENT — but they skew ``_next_version``, so a killed-and-resumed
+    ingest would number its snapshots differently from a clean run.
+    Runs under the commit lock so it never races an in-flight commit;
+    the COMMITS.jsonl journal record survives as the crash diagnostic."""
+    md = _meta_dir(table_dir)
+    if not os.path.exists(os.path.join(md, "CURRENT")):
+        return []
+    removed: List[int] = []
+    with commit_proto.commit_lock(md):
+        cur = current_version(table_dir)
+        for name in os.listdir(md):
+            if not (name.startswith("v") and name.endswith(".json")):
+                continue
+            try:
+                v = int(name[1:9])
+            except ValueError:
+                continue
+            if v > cur:
+                os.unlink(os.path.join(md, name))
+                removed.append(v)
+    return sorted(removed)
 
 
 def load_snapshot(table_dir: str,
@@ -122,30 +218,38 @@ def create_table(table_dir: str, at: pa.Table,
     os.makedirs(table_dir, exist_ok=True)
     if partition_col is not None:
         at = at.sort_by([(partition_col, "ascending")])
-    version = _next_version(table_dir) if is_ndslake(table_dir) else 0
-    snap = Snapshot(version, time.time(), [_new_data_file(table_dir, at)],
-                    partition_col, "create")
-    _write_snapshot(table_dir, snap)
+    has_current = is_ndslake(table_dir) and os.path.exists(
+        os.path.join(_meta_dir(table_dir), "CURRENT"))
+    expected = current_version(table_dir) if has_current else None
+    _commit_snapshot(table_dir, [_new_data_file(table_dir, at)],
+                     partition_col, "create", expected)
 
 
-def append(table_dir: str, at: pa.Table) -> None:
-    """INSERT INTO: add a data file in a new snapshot."""
-    prev = load_snapshot(table_dir)
+def append(table_dir: str, at: pa.Table,
+           expected_version: Optional[int] = None) -> None:
+    """INSERT INTO: add a data file in a new snapshot.
+
+    ``expected_version`` is the snapshot this write is based on
+    (default: CURRENT at load time); if another writer advances the
+    table before this commit publishes, the CAS raises
+    ``CommitConflict`` instead of silently clobbering."""
+    prev = load_snapshot(table_dir, expected_version)
     if prev.partition_col is not None and prev.partition_col in at.column_names:
         at = at.sort_by([(prev.partition_col, "ascending")])
-    snap = Snapshot(_next_version(table_dir), time.time(),
-                    prev.files + [_new_data_file(table_dir, at)],
-                    prev.partition_col, "append")
-    _write_snapshot(table_dir, snap)
+    _commit_snapshot(table_dir,
+                     prev.files + [_new_data_file(table_dir, at)],
+                     prev.partition_col, "append", prev.version)
 
 
 def delete_rows(table_dir: str,
-                predicate: Callable[[pa.Table], np.ndarray]) -> int:
+                predicate: Callable[[pa.Table], np.ndarray],
+                expected_version: Optional[int] = None) -> int:
     """DELETE FROM ... WHERE: merge-on-read deletion vectors.
 
     `predicate` maps a data-file's (live-row) arrow table to a boolean
-    delete-mask over those rows.  Returns number of rows deleted."""
-    prev = load_snapshot(table_dir)
+    delete-mask over those rows.  Returns number of rows deleted.
+    ``expected_version`` as in :func:`append`."""
+    prev = load_snapshot(table_dir, expected_version)
     os.makedirs(os.path.join(table_dir, "deletes"), exist_ok=True)
     new_files: List[Dict] = []
     total = 0
@@ -169,9 +273,8 @@ def delete_rows(table_dir: str,
         nf = dict(fmeta)
         nf["deletes"] = rel
         new_files.append(nf)
-    snap = Snapshot(_next_version(table_dir), time.time(), new_files,
-                    prev.partition_col, "delete")
-    _write_snapshot(table_dir, snap)
+    _commit_snapshot(table_dir, new_files, prev.partition_col,
+                     "delete", prev.version)
     return total
 
 
@@ -198,10 +301,10 @@ def rollback_to_version(table_dir: str, version: int) -> int:
     so later timestamp rollbacks can't resurrect an abandoned branch).
     Returns the new snapshot's version."""
     target = load_snapshot(table_dir, version)
-    snap = Snapshot(_next_version(table_dir), time.time(),
-                    [dict(f) for f in target.files], target.partition_col,
-                    f"rollback(v{version})")
-    _write_snapshot(table_dir, snap)
+    snap = _commit_snapshot(table_dir, [dict(f) for f in target.files],
+                            target.partition_col,
+                            f"rollback(v{version})",
+                            current_version(table_dir))
     return snap.version
 
 

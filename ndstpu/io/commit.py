@@ -1,0 +1,115 @@
+"""Shared commit protocol for the ACID table formats (ingest layer 1).
+
+Both table formats originally published new versions under a
+single-writer assumption — ndslake swung ``CURRENT`` by atomic rename,
+ndsdelta clobbered commit files with ``os.replace`` — which silently
+last-writer-wins the moment two streams touch one table.  Continuous
+ingest (docs/ROBUSTNESS.md "Ingest commit protocol") needs a journaled
+compare-and-swap instead, built from three pieces shared here:
+
+* :class:`CommitConflict` — the typed, *retryable* loser's outcome.
+  Classified transient by ndstpu/faults/taxonomy.py, so it flows into
+  the PR-5 retry machinery unchanged: reload the table state, rebase
+  the write, commit again.
+* :func:`commit_lock` — an ``O_CREAT|O_EXCL`` lock file serializing
+  the check-version/allocate-version/publish window per table.  A
+  writer SIGKILLed inside the window leaves the lock behind; a later
+  writer breaks it once it is older than the lease
+  (``NDSTPU_COMMIT_LEASE_S``, default 10 s) — safe because commits are
+  sub-second and the expected-version check re-runs under the new
+  lock either way.
+* :func:`journal` — an append-only ``COMMITS.jsonl`` audit trail
+  (io/atomic.append_jsonl) written *before* the pointer swing: a
+  journal record whose version never became current is the diagnostic
+  signature of a crash mid-commit, never a correctness hazard.
+
+Crash atomicity is inherited from io/atomic.py: manifests and commit
+files are complete before the single publishing rename/link, so a
+``kill -9`` anywhere leaves either the old or the new snapshot
+current, never a torn one.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Iterator, Optional
+
+LEASE_ENV = "NDSTPU_COMMIT_LEASE_S"
+DEFAULT_LEASE_S = 10.0
+LOCK_BASENAME = "COMMIT.lock"
+JOURNAL_BASENAME = "COMMITS.jsonl"
+
+
+class CommitConflict(RuntimeError):
+    """Another writer advanced the table between this writer's snapshot
+    load and its commit publish.  Transient by taxonomy: the correct
+    response is reload + rebase + retry, which faults/retry.py does for
+    any caller inside run_with_retry and the micro-batch ingestor
+    (harness/ingest.py) does batch-wide."""
+
+    def __init__(self, table_dir: str, expected: Optional[int],
+                 found: Optional[int]):
+        exp = "<none>" if expected is None else f"v{expected}"
+        fnd = "<none>" if found is None else f"v{found}"
+        super().__init__(
+            f"commit conflict in {table_dir}: write based on {exp} but "
+            f"the table is at {fnd} — reload and retry")
+        self.table_dir = table_dir
+        self.expected = expected
+        self.found = found
+
+
+def lease_s() -> float:
+    env = os.environ.get(LEASE_ENV)
+    if env:
+        try:
+            return max(float(env), 0.1)
+        except ValueError:
+            pass
+    return DEFAULT_LEASE_S
+
+
+@contextlib.contextmanager
+def commit_lock(meta_dir: str) -> Iterator[str]:
+    """Exclusive per-table commit section via an ``O_EXCL`` lock file
+    under the table's metadata dir.  Progress is guaranteed without a
+    timeout: a dead holder's lock goes stale after the lease and is
+    broken by the next writer (two breakers may race on the unlink;
+    both then race on ``O_EXCL``, which exactly one wins)."""
+    os.makedirs(meta_dir, exist_ok=True)
+    path = os.path.join(meta_dir, LOCK_BASENAME)
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            break
+        except FileExistsError:
+            try:
+                age = time.time() - os.stat(path).st_mtime
+            except OSError:
+                continue  # holder released between open and stat
+            if age > lease_s():
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+                continue
+            time.sleep(0.005)
+    try:
+        os.write(fd, json.dumps(
+            {"pid": os.getpid(), "ts": time.time()}).encode())
+        os.close(fd)
+        yield path
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+
+
+def journal_path(meta_dir: str) -> str:
+    return os.path.join(meta_dir, JOURNAL_BASENAME)
+
+
+def journal(meta_dir: str, record: dict) -> None:
+    """Append one commit-audit record (durable, torn-tail tolerant)."""
+    from ndstpu.io import atomic
+    atomic.append_jsonl(journal_path(meta_dir), record)

@@ -29,13 +29,21 @@ Semantics:
     version, preserving linear history exactly like `RESTORE TABLE ...
     TO VERSION AS OF` (reference rollback parity: nds_rollback.py:37-59).
 
-Writers are single-process per table (the DM phase runs one maintenance
-stream per table family); commits are published by atomic rename, so
-readers never observe a half-written log entry.
+Commit protocol (docs/ROBUSTNESS.md "Ingest commit protocol"): the
+version-numbered commit filename IS the compare-and-swap — commits are
+published create-exclusive (fsynced temp + ``os.link``), so two writers
+racing to the same version each write a temp and exactly one link wins;
+the loser gets a typed, retryable ``CommitConflict`` (transient in the
+faults taxonomy) instead of silently clobbering — Delta's optimistic
+concurrency, where ndslake serializes under a lock file.  Readers never
+observe a half-written log entry, and a SIGKILL mid-commit leaves at
+worst an unlinked temp.  Checkpoints and ``_last_checkpoint`` remain
+clobbering renames: they are derived, idempotent state.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
@@ -46,6 +54,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
+
+from ndstpu.io import commit as commit_proto
 
 CHECKPOINT_EVERY = 10
 
@@ -93,19 +103,112 @@ def current_version(table_dir: str) -> int:
 
 
 def _publish(path: str, lines: List[str]) -> None:
+    """Clobbering atomic publish — checkpoints/_last_checkpoint only
+    (derived, idempotent state); commits go through _publish_commit."""
     tmp = path + f".tmp.{uuid.uuid4().hex}"
     with open(tmp, "w") as f:
         f.write("\n".join(lines) + "\n")
     os.replace(tmp, path)
 
 
+def _publish_commit(table_dir: str, version: int,
+                    lines: List[str]) -> None:
+    """Create-exclusive CAS publish of one commit file: fsynced temp +
+    ``os.link``, so exactly one of N racing writers claims the version
+    and the rest raise ``CommitConflict``."""
+    from ndstpu import obs
+    path = _commit_path(table_dir, version)
+    tmp = path + f".tmp.{uuid.uuid4().hex}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        obs.inc("engine.ingest.conflicts")
+        raise commit_proto.CommitConflict(
+            table_dir, version - 1, current_version(table_dir))
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+    from ndstpu.io.atomic import _fsync_dir
+    _fsync_dir(_log_dir(table_dir))
+    obs.inc("engine.ingest.commits")
+
+
+def abort_to_version(table_dir: str, version: int) -> int:
+    """Crash-recovery retraction: remove every commit file (and
+    checkpoint) above ``version``.  Unlike :func:`rollback_to_version`
+    (which appends a NEW replace-all commit — the time-travel path),
+    this rewrites the log, so it is only sound when no reader can hold
+    the retracted versions: recovering a micro-batch whose journal
+    intent never reached done (harness/ingest.py), before serving
+    resumes.  Commits unlink highest-first so ``current_version`` never
+    crosses a gap mid-abort; stale ``_last_checkpoint`` is dropped
+    (replay discovers checkpoints by listing, the pointer is
+    advisory).  Retracted data files stay on disk as unreachable
+    garbage."""
+    _replay(table_dir, version)  # target must be replayable
+    ld = _log_dir(table_dir)
+    doomed = []
+    for name in os.listdir(ld):
+        if name.endswith(".checkpoint.json"):
+            v = int(name.split(".")[0])
+        elif name.endswith(".json"):
+            v = int(name[:-5])
+        else:
+            continue
+        if v > version:
+            doomed.append((v, name))
+    for _v, name in sorted(doomed, reverse=True):
+        with contextlib.suppress(OSError):
+            os.unlink(os.path.join(ld, name))
+    lc = os.path.join(ld, "_last_checkpoint")
+    if doomed and os.path.exists(lc):
+        try:
+            with open(lc) as f:
+                if json.load(f).get("version", 0) > version:
+                    os.unlink(lc)
+        except (ValueError, OSError):
+            with contextlib.suppress(OSError):
+                os.unlink(lc)
+    from ndstpu.io.atomic import _fsync_dir
+    _fsync_dir(ld)
+    return version
+
+
+def gc_orphan_manifests(table_dir: str) -> List[str]:
+    """Remove leftover ``.tmp.*`` commit files (a crash between temp
+    write and ``os.link``).  ndsdelta versions are numbered from
+    *published* commit files only, so — unlike ndslake manifests —
+    orphan temps never skew numbering; this is pure hygiene."""
+    ld = _log_dir(table_dir)
+    removed: List[str] = []
+    try:
+        names = os.listdir(ld)
+    except OSError:
+        return removed
+    for name in names:
+        if ".tmp." in name:
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(ld, name))
+                removed.append(name)
+    return sorted(removed)
+
+
 def _commit(table_dir: str, version: int, actions: List[Dict],
             operation: str, ts: Optional[float] = None) -> None:
+    from ndstpu import faults
     ts = time.time() if ts is None else ts
     lines = [json.dumps({"commitInfo": {
         "timestamp": ts, "operation": operation}})]
     lines += [json.dumps(a) for a in actions]
-    _publish(_commit_path(table_dir, version), lines)
+    # crash-mid-commit probe: a fault here fires with the data files
+    # already written but the commit unpublished — the old table state
+    # stays current, the orphan parts are garbage, never corruption
+    faults.check("ingest.commit", key=table_dir)
+    _publish_commit(table_dir, version, lines)
     if version % CHECKPOINT_EVERY == 0 and version > 0:
         st = _replay(table_dir, version)
         cp = os.path.join(_log_dir(table_dir),
@@ -202,9 +305,15 @@ def create_table(table_dir: str, at: pa.Table,
     _commit(table_dir, version, actions, "CREATE OR REPLACE")
 
 
-def append(table_dir: str, at: pa.Table) -> None:
-    """INSERT INTO: one add action in a new commit."""
-    st = _replay(table_dir)
+def append(table_dir: str, at: pa.Table,
+           expected_version: Optional[int] = None) -> None:
+    """INSERT INTO: one add action in a new commit.
+
+    ``expected_version`` is the version this write is based on
+    (default: current at replay time); when another writer claims
+    ``expected_version + 1`` first, the create-exclusive publish
+    raises ``CommitConflict``."""
+    st = _replay(table_dir, expected_version)
     if st.partition_col is not None and st.partition_col in at.column_names:
         at = at.sort_by([(st.partition_col, "ascending")])
     _commit(table_dir, st.version + 1,
@@ -212,11 +321,13 @@ def append(table_dir: str, at: pa.Table) -> None:
 
 
 def delete_rows(table_dir: str,
-                predicate: Callable[[pa.Table], np.ndarray]) -> int:
+                predicate: Callable[[pa.Table], np.ndarray],
+                expected_version: Optional[int] = None) -> int:
     """DELETE FROM ... WHERE, copy-on-write: every file with matches is
     rewritten without the deleted rows (remove+add in one commit).
-    Returns the number of rows deleted."""
-    st = _replay(table_dir)
+    Returns the number of rows deleted.  ``expected_version`` as in
+    :func:`append`."""
+    st = _replay(table_dir, expected_version)
     actions: List[Dict] = []
     total = 0
     for fmeta in list(st.files.values()):

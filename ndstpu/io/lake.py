@@ -10,9 +10,13 @@ table directory's metadata marker (`_ndslake/` vs `_delta_log/`).
 
 from __future__ import annotations
 
-from typing import Optional
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
 
 from ndstpu.io import acid, deltalog
+from ndstpu.io.commit import CommitConflict  # noqa: F401  (re-export)
 
 FORMATS = ("ndslake", "ndsdelta")
 
@@ -47,12 +51,20 @@ def read(table_dir: str, version: Optional[int] = None, columns=None):
     return detect(table_dir).read(table_dir, version, columns=columns)
 
 
-def append(table_dir: str, at) -> None:
-    detect(table_dir).append(table_dir, at)
+def append(table_dir: str, at,
+           expected_version: Optional[int] = None) -> None:
+    detect(table_dir).append(table_dir, at,
+                             expected_version=expected_version)
 
 
-def delete_rows(table_dir: str, predicate) -> int:
-    return detect(table_dir).delete_rows(table_dir, predicate)
+def delete_rows(table_dir: str, predicate,
+                expected_version: Optional[int] = None) -> int:
+    return detect(table_dir).delete_rows(
+        table_dir, predicate, expected_version=expected_version)
+
+
+def current_version(table_dir: str) -> int:
+    return detect(table_dir).current_version(table_dir)
 
 
 def rollback_to_timestamp(table_dir: str, ts: float) -> int:
@@ -61,3 +73,65 @@ def rollback_to_timestamp(table_dir: str, ts: float) -> int:
 
 def rollback_to_version(table_dir: str, version: int) -> int:
     return detect(table_dir).rollback_to_version(table_dir, version)
+
+
+def abort_to_version(table_dir: str, version: int) -> int:
+    """Crash-recovery retraction (history-REWRITING, unlike
+    rollback_to_version) — see the format modules for the safety
+    contract.  Used only by the ingest restore path."""
+    return detect(table_dir).abort_to_version(table_dir, version)
+
+
+def gc_orphan_manifests(table_dir: str) -> list:
+    return detect(table_dir).gc_orphan_manifests(table_dir)
+
+
+def gc_orphans(warehouse: str) -> Dict[str, list]:
+    """GC unpublished commit leftovers in every ACID table (a crash or
+    injected fault between manifest write and pointer publish).  The
+    ingest restore/resume path runs this so a retried run's version
+    numbering matches a clean run's (harness/ingest.py)."""
+    out: Dict[str, list] = {}
+    for name in lake_tables(warehouse):
+        removed = gc_orphan_manifests(os.path.join(warehouse, name))
+        if removed:
+            out[name] = removed
+    return out
+
+
+def lake_tables(warehouse: str) -> List[str]:
+    """Names of the ACID-format table directories under a warehouse."""
+    try:
+        names = sorted(os.listdir(warehouse))
+    except OSError:
+        return []
+    return [n for n in names if is_lake(os.path.join(warehouse, n))]
+
+
+def versions_vector(warehouse: str) -> Dict[str, int]:
+    """Per-table CURRENT versions for every ACID table in a warehouse
+    — the durable half of a snapshot pin (engine/session.py)."""
+    out: Dict[str, int] = {}
+    for name in lake_tables(warehouse):
+        try:
+            out[name] = current_version(os.path.join(warehouse, name))
+        except (OSError, ValueError):
+            # table mid-create (metadata dir exists, no commit yet)
+            continue
+    return out
+
+
+def warehouse_epoch(warehouse: str) -> Optional[str]:
+    """Durable data-version identity of a warehouse: a stable hash over
+    every ACID table's CURRENT version.  Two processes observing the
+    same committed state compute the same epoch, whatever their
+    in-memory catalogs look like — this is what ledger rows are stamped
+    with (obs/ledger.py extra.snapshot_epoch) and what the ingest
+    differential keys its per-epoch result map on
+    (scripts/ingest_smoke.py).  None when the warehouse has no ACID
+    tables (nothing versioned to pin)."""
+    vec = versions_vector(warehouse)
+    if not vec:
+        return None
+    blob = json.dumps(sorted(vec.items()))
+    return "e" + hashlib.sha256(blob.encode()).hexdigest()[:12]

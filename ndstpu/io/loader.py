@@ -391,6 +391,151 @@ class ParquetChunkSource(ChunkSource):
         return out
 
 
+class LakeChunkSource(ChunkSource):
+    """Snapshot-pinned out-of-core scan over an ACID lake table
+    (``ndslake`` or ``ndsdelta``).
+
+    Where :class:`ParquetChunkSource` refuses lake tables outright,
+    this source reads a PINNED snapshot version (default: CURRENT at
+    construction): the data-file list comes from that version's
+    manifest/log replay, and ndslake deletion vectors are applied as
+    keep-masks at scan time — so appends and deletes committed *after*
+    the pin land in snapshots this source never consults.  This is the
+    chunk-source half of snapshot-pinned reads (docs/ARCHITECTURE.md):
+    an in-flight streaming query keeps scanning its admission-time
+    version while ingest advances the table underneath it.
+
+    File-granular rather than row-group-granular: lake data files are
+    micro-batch sized (one per refresh-function commit), so a read
+    decodes each overlapping file, masks its deleted rows, and slices
+    the requested live-row window.  String columns are rejected like
+    ParquetChunkSource (per-chunk dictionaries would not share a code
+    space).
+    """
+
+    def __init__(self, table_dir: str, table: Optional[str] = None,
+                 columns: Optional[Sequence[str]] = None,
+                 version: Optional[int] = None,
+                 use_decimal: bool = True):
+        import pyarrow.parquet as pq
+        self._pq = pq
+        self._dir = table_dir
+        self.table = table or os.path.basename(
+            os.path.normpath(table_dir))
+        mod = lake.detect(table_dir)
+        if mod is None:
+            raise StreamUnsupported(
+                f"{table_dir} is not an ACID lake table")
+        from ndstpu.io import acid as _acid
+        self.version = mod.current_version(table_dir) \
+            if version is None else version
+        if mod is _acid:
+            snap = _acid.load_snapshot(table_dir, self.version)
+            file_metas = [(fm["path"], fm.get("deletes"))
+                          for fm in snap.files]
+        else:
+            st = mod._replay(table_dir, self.version)
+            # ndsdelta deletes are copy-on-write: no mask needed
+            file_metas = [(fm["path"], None)
+                          for fm in st.files.values()]
+        schemas = {**nds_schema.get_schemas(use_decimal),
+                   **nds_schema.get_maintenance_schemas(use_decimal)}
+        self._schema = schemas.get(self.table)
+        # global live-row index: (abs path, keep-mask-or-None,
+        # global_start, live_rows)
+        self._files: List[tuple] = []
+        total = 0
+        first_cols: Optional[List[str]] = None
+        for rel, drel in file_metas:
+            fp = os.path.join(table_dir, rel)
+            n = pq.ParquetFile(fp).metadata.num_rows
+            if first_cols is None:
+                first_cols = list(pq.ParquetFile(fp).schema_arrow.names)
+            keep = None
+            live = n
+            if drel:
+                dels = np.load(os.path.join(table_dir, drel))
+                keep = np.ones(n, dtype=bool)
+                keep[dels] = False
+                live = int(keep.sum())
+            if live:
+                self._files.append((fp, keep, total, live))
+                total += live
+        self.num_rows = total
+        if columns is None:
+            columns = list(first_cols or [])
+        missing = [c for c in columns if c not in (first_cols or [])]
+        if missing:
+            raise StreamUnsupported(
+                f"columns {missing} not in {self.table} data files")
+        self._cols = self.columns = list(columns)
+        if self._schema is not None:
+            for c in self._cols:
+                try:
+                    if self._schema.column(c).dtype.kind == "string":
+                        raise StreamUnsupported(
+                            f"string column {c}: per-chunk dictionaries "
+                            f"do not share a code space")
+                except KeyError:
+                    pass
+        self._meta: Optional[Dict[str, tuple]] = None
+
+    def column_meta(self) -> Dict[str, tuple]:
+        if self._meta is None:
+            if not self._files:
+                raise StreamUnsupported(
+                    f"pinned snapshot v{self.version} of {self.table} "
+                    f"has no live rows to derive column metadata from")
+            t = self._decode(*self._files[0][:2])
+            meta = {}
+            for n in self._cols:
+                c = t.column(n)
+                if c.ctype.kind == "string":
+                    raise StreamUnsupported(
+                        f"string column {n} cannot stream")
+                meta[n] = (c.ctype, c.data.dtype, None)
+            self._meta = meta
+        return self._meta
+
+    def _decode(self, path: str,
+                keep: Optional[np.ndarray]) -> columnar.Table:
+        at = self._pq.read_table(path, columns=self._cols)
+        t = columnar.from_arrow(at.select(self._cols), self._schema)
+        if keep is not None:
+            t = t.filter(keep)
+        return t
+
+    def read(self, start: int, count: int) -> ChunkPayload:
+        from ndstpu import faults, obs
+        faults.check("io.read", key=f"{self.table}@{start}")
+        end = min(start + count, self.num_rows)
+        pieces: List[columnar.Table] = []
+        nbytes = 0
+        for fp, keep, g_start, g_live in self._files:
+            if g_start + g_live <= start or g_start >= end:
+                continue
+            t = self._decode(fp, keep)
+            lo = max(start - g_start, 0)
+            hi = min(end - g_start, g_live)
+            pieces.append(columnar.Table({
+                n: columnar.Column(
+                    c.data[lo:hi], c.ctype,
+                    None if c.valid is None else c.valid[lo:hi],
+                    c.dictionary)
+                for n, c in t.columns.items()}))
+        out: ChunkPayload = {}
+        for n in self._cols:
+            cols = [p.column(n) for p in pieces]
+            data = np.concatenate([c.data for c in cols]) if cols \
+                else np.empty(0, dtype=self.column_meta()[n][1])
+            valid = np.concatenate([c.validity() for c in cols]) if cols \
+                else np.empty(0, dtype=bool)
+            nbytes += data.nbytes + valid.nbytes
+            out[n] = (data, valid)
+        obs.inc("io.scan.bytes", nbytes)
+        return out
+
+
 class ChunkScanPool:
     """Bounded read-ahead scan/decode pool in front of the executor.
 

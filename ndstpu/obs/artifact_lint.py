@@ -42,14 +42,16 @@ CITED_RE = re.compile(
     r"|\bPLAN_LINT\.(?:json|md)\b"
     r"|\bCANON_AUDIT\.(?:json|md)\b"
     r"|\bMQO_AUDIT\.(?:json|md)\b"
-    r"|\bRUN_STATE\.json\b")
+    r"|\bRUN_STATE\.json\b"
+    r"|\bINGEST_DIFF\.json\b")
 
 EXEMPT_MARKERS = ("pending", "uncommitted", "not committed")
 
-# recognized per-run journals: docs cite these by name (they define the
-# resume contract, docs/ROBUSTNESS.md) but every run writes its own
-# next to its artifacts — there is never a committed copy to point at
-RUNTIME_ARTIFACTS = ("RUN_STATE.json",)
+# recognized per-run journals/artifacts: docs cite these by name (they
+# define the resume/differential contracts, docs/ROBUSTNESS.md) but
+# every run writes its own next to its artifacts — there is never a
+# committed copy to point at
+RUNTIME_ARTIFACTS = ("RUN_STATE.json", "INGEST_DIFF.json")
 
 _GROUPBY_DEFAULT_RE = re.compile(
     r'^GROUPBY_DEFAULT\s*=\s*["\'](\w+)["\']', re.MULTILINE)

@@ -193,16 +193,47 @@ class Ledger:
         return out
 
     def best_warm(self, query: str, engine: Optional[str] = None,
-                  scale_factor=None) -> Optional[float]:
+                  scale_factor=None,
+                  snapshot_epoch: Optional[str] = None
+                  ) -> Optional[float]:
         """Fastest known warm wall.  Cold entries contribute their
         execute_s split — the post-compile execution is the warm proxy
-        that lets a second run be judged against a first-ever cold one."""
+        that lets a second run be judged against a first-ever cold one.
+
+        With ``snapshot_epoch``, entries stamped with a DIFFERENT
+        ``extra.snapshot_epoch`` (io/lake.warehouse_epoch) are excluded
+        — a warm wall measured over other data is not a baseline for
+        this data.  Unstamped (pre-epoch) entries still qualify, so
+        legacy ledgers keep comparing until re-stamped."""
+        def epoch_ok(e: dict) -> bool:
+            if snapshot_epoch is None:
+                return True
+            ep = (e.get("extra") or {}).get("snapshot_epoch")
+            return ep is None or ep == snapshot_epoch
+
         vals = [e["wall_s"] for e in self._match(query, engine,
-                                                 scale_factor, "warm")]
+                                                 scale_factor, "warm")
+                if epoch_ok(e)]
         vals += [e["execute_s"] for e in self._match(query, engine,
                                                      scale_factor, "cold")
-                 if e.get("execute_s", 0.0) > 1e-6]
+                 if e.get("execute_s", 0.0) > 1e-6 and epoch_ok(e)]
         return min(vals) if vals else None
+
+    def warm_epochs(self, query: str, engine: Optional[str] = None,
+                    scale_factor=None) -> set:
+        """Distinct stamped snapshot epochs among this scope's
+        baseline-eligible entries (warm walls + cold execute proxies)
+        — the sentinel's data-changed detector."""
+        out = set()
+        for warmth in ("warm", "cold"):
+            for e in self._match(query, engine, scale_factor, warmth):
+                if warmth == "cold" and \
+                        e.get("execute_s", 0.0) <= 1e-6:
+                    continue
+                ep = (e.get("extra") or {}).get("snapshot_epoch")
+                if ep:
+                    out.add(ep)
+        return out
 
     def expected_cold(self, query: str, engine: Optional[str] = None,
                       scale_factor=None) -> Optional[float]:

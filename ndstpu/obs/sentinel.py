@@ -10,6 +10,13 @@ best-known-warm ledger prior (ndstpu/obs/ledger.py):
   so the run still contributes a baseline.
 * ``new`` — no warm baseline exists for this (engine, scale-factor)
   scope; the run seeds one.
+* ``data-changed`` — warm baselines exist for this scope but only
+  under OTHER snapshot epochs (``extra.snapshot_epoch``, stamped from
+  io/lake.warehouse_epoch): the warehouse's data-version vector moved
+  (ingest/maintenance committed), so comparing walls would blame the
+  engine for the data.  Never ``regressed``; the run seeds this
+  epoch's baseline.  Entries with no stamp (legacy ledgers) stay
+  comparable everywhere.
 * ``regressed`` / ``improved`` — warm wall beyond both the relative
   tolerance and the absolute floor (both guards: a 0.1 s query
   jittering to 0.14 s is noise, not a regression).
@@ -43,7 +50,8 @@ REL_TOL = 0.25      # regressed/improved only beyond +-25% ...
 ABS_FLOOR_S = 0.25  # ... AND more than 0.25s absolute movement
 
 VERDICTS = ("improved", "flat", "regressed", "cold-compile", "new",
-            "failed", "failed-transient", "failed-permanent")
+            "data-changed", "failed", "failed-transient",
+            "failed-permanent")
 
 
 def classify_query(query: str, wall_s: float, compile_s: float,
@@ -99,11 +107,15 @@ def classify_query(query: str, wall_s: float, compile_s: float,
 def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
                  engine: Optional[str] = None, scale_factor=None,
                  rel_tol: float = REL_TOL,
-                 abs_floor_s: float = ABS_FLOOR_S) -> dict:
+                 abs_floor_s: float = ABS_FLOOR_S,
+                 snapshot_epoch: Optional[str] = None) -> dict:
     """Classify a run's per-query summaries (the power sidecar /
     ``query_summaries()`` shape: query, wall_s, compile_s, execute_s,
     optional attrs.error).  Baselines are scoped strictly to
-    (engine, scale_factor) — cross-engine comparisons are meaningless."""
+    (engine, scale_factor) — cross-engine comparisons are meaningless —
+    and, when ``snapshot_epoch`` is given, to entries of the same data
+    epoch (or unstamped legacy entries); a query whose only warm
+    baselines live under other epochs verdicts ``data-changed``."""
     verdicts: List[dict] = []
     for q in queries:
         name = q["query"]
@@ -122,11 +134,25 @@ def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
             verdicts.append(v)
             continue
         base = led.best_warm(name, engine=engine,
-                             scale_factor=scale_factor)
+                             scale_factor=scale_factor,
+                             snapshot_epoch=snapshot_epoch)
         v = classify_query(
             name, q.get("wall_s", 0.0), q.get("compile_s", 0.0),
             q.get("execute_s", 0.0), base, rel_tol=rel_tol,
             abs_floor_s=abs_floor_s)
+        if v["verdict"] == "new" and snapshot_epoch is not None:
+            # no same-epoch baseline: distinguish genuinely-new from
+            # data-changed (baselines exist, but under other epochs)
+            others = led.warm_epochs(name, engine=engine,
+                                     scale_factor=scale_factor)
+            others.discard(snapshot_epoch)
+            if others:
+                v["verdict"] = "data-changed"
+                v["reason"] = (
+                    f"warm baselines exist only under other snapshot "
+                    f"epoch(s) {sorted(others)} — the data changed "
+                    f"under this query, not the engine; seeding epoch "
+                    f"{snapshot_epoch}")
         # spine-warm is its own warmth class (ndstpu/obs/ledger.py):
         # a query served cached spine tables (engine/spine.py) skipped
         # its spine's scan/filter/join work, so its wall is measured
@@ -162,9 +188,10 @@ def classify_run(queries: Iterable[dict], led: "ledger_mod.Ledger",
 
 def markdown_table(result: dict) -> str:
     """REGRESSIONS.md body: one row per query, regressions first."""
-    order = {"regressed": 0, "improved": 1, "new": 2, "flat": 3,
-             "cold-compile": 4, "failed": 5, "failed-transient": 6,
-             "failed-permanent": 7}
+    order = {"regressed": 0, "improved": 1, "new": 2,
+             "data-changed": 3, "flat": 4, "cold-compile": 5,
+             "failed": 6, "failed-transient": 7,
+             "failed-permanent": 8}
     rows = sorted(result["verdicts"],
                   key=lambda v: (order.get(v["verdict"], 9), v["query"]))
     lines = [

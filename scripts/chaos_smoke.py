@@ -29,6 +29,13 @@ across 2 kinds (transient, hang); a standalone power run then injects
 an ``execute`` *permanent* fault and asserts it surfaces classified —
 ``faultTaxonomy.counts.permanent`` in the sidecar and a
 ``failed-permanent`` sentinel verdict — never as a silent skip.
+
+A final scenario SIGKILLs ``ndstpu.harness.ingest`` mid-run over a
+tiny synthetic lake warehouse and resumes it: the intent/done journal
+plus crash retraction (io lake ``abort_to_version``) must land the
+resumed run on snapshot versions and contents identical to an
+uninterrupted control (the full interleaved-vs-quiesced differential
+is scripts/ingest_smoke.py's job).
 """
 from __future__ import annotations
 
@@ -304,7 +311,51 @@ def main() -> int:
     assert verdicts.get("failed-permanent", 0) >= 1, \
         f"no failed-permanent sentinel verdict: {verdicts}"
 
-    print("chaos smoke OK: crash + 2 SIGKILLs resumed to "
+    # ---- G. SIGKILL mid-ingest resumes to a baseline-identical ------
+    # snapshot (harness/ingest.py journal + abort_to_version; the full
+    # differential lives in scripts/ingest_smoke.py — this scenario
+    # keeps the crash shape in the one-command chaos gate)
+    from ndstpu.io import lake
+    import numpy as np
+    import pyarrow as pa
+    wh_g = work / "ingest_wh"
+    wh_g.mkdir()
+    for t in ("alpha", "beta"):
+        at = pa.table({"k": np.arange(8, dtype=np.int64),
+                       "v": np.arange(8, dtype=np.float64)})
+        lake.create_table("ndslake", str(wh_g / t), at)
+    wh_g_ctl = work / "ingest_wh_ctl"
+    shutil.copytree(wh_g, wh_g_ctl)
+    ingest_cmd = [sys.executable, "-m", "ndstpu.harness.ingest",
+                  wh_g, "--synthetic", "4"]
+    ctl_cmd = list(ingest_cmd)
+    ctl_cmd[3] = wh_g_ctl
+    run_logged(ctl_cmd, base_env(), work / "g_ctl.log", check_rc=0)
+    g_log = work / "g.log"
+    run_until_killed(
+        ingest_cmd + ["--batch_pause_s", "2.0"], base_env(), g_log,
+        trigger=lambda: "done (attempts=" in
+        (g_log.read_text() if g_log.exists() else ""),
+        what="first journaled-done ingest micro-batch")
+    run_logged(ingest_cmd + ["--resume"], base_env(),
+               work / "g_resume.log", check_rc=0)
+    assert "journaled done" in (work / "g_resume.log").read_text(), \
+        "ingest resume re-applied an already-done micro-batch"
+    assert lake.versions_vector(str(wh_g)) == \
+        lake.versions_vector(str(wh_g_ctl)), \
+        "SIGKILLed+resumed ingest landed on different snapshot versions"
+    assert lake.warehouse_epoch(str(wh_g)) == \
+        lake.warehouse_epoch(str(wh_g_ctl))
+    for t in ("alpha", "beta"):
+        a = lake.read(str(wh_g / t)).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        b = lake.read(str(wh_g_ctl / t)).sort_by(
+            [("k", "ascending"), ("v", "ascending")])
+        assert a.equals(b), f"{t}: resumed contents differ from control"
+    print("ingest SIGKILL scenario OK: resumed to control-identical "
+          "snapshot versions and contents")
+
+    print("chaos smoke OK: crash + 3 SIGKILLs resumed to "
           "baseline-identical results; permanent fault surfaced "
           "classified")
     shutil.rmtree(work, ignore_errors=True)

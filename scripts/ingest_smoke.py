@@ -1,0 +1,406 @@
+"""Ingest differential smoke: live HTAP vs quiesced batch, bit-exact.
+
+CI gate for the crash-consistent continuous-ingest layer
+(docs/ROBUSTNESS.md "Ingest commit protocol", docs/ARCHITECTURE.md
+snapshot pinning).  One tiny corpus, four phases:
+
+1. **Interleaved** — query threads pin snapshots
+   (``Session.pin_snapshot``) and run fixed queries against one shared
+   Session while a `MicroBatchIngestor` applies real LF_*/DF_* refresh
+   functions concurrently.  Every observation is keyed by the pin's
+   ``warehouse_epoch``; a live (unpinned) spine-cached query rides
+   along so an ingest commit demonstrably drops the stale spine entry
+   (``engine.snapshot.stale_drops`` >= 1).
+2. **Quiesced ground truth** — the SAME refresh functions replayed one
+   batch at a time over a pristine copy, recording each boundary
+   epoch's query digests.  Every interleaved observation must be
+   byte-identical to the quiesced digest of its epoch: concurrency may
+   only change *which* epochs a query sees, never *what* an epoch
+   contains.
+3. **Chaos** — the interleaved run again with
+   ``ingest.commit:transient:1.0:times=1`` injected: the first lake
+   commit dies pre-publish, the retry retracts + GCs the orphan
+   manifest, and the run must land on the SAME final epoch and
+   truth-identical per-epoch digests, with ``engine.ingest.retries``
+   >= 1.
+4. **SIGKILL mid-ingest** — the ingest CLI
+   (``python -m ndstpu.harness.ingest``) killed -9 after its first
+   journaled batch, then ``--resume``d: final per-table snapshot
+   versions, warehouse epoch, and table contents must equal an
+   uninterrupted control run, and every ``CURRENT`` pointer must stay
+   readable (old or new, never torn).
+
+Writes ``INGEST_DIFF.json`` (a per-run artifact, like RUN_STATE.json —
+never committed) next to the work dir for the CI log.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# insert + delete refresh functions the SQL frontend fully plans (the
+# returns-side LF_* need non-equi left joins — a pre-existing planner
+# gap, not an ingest one)
+FUNCS = ["LF_SS", "LF_WS", "DF_SS"]
+
+QUERIES = {
+    "agg_ss": "SELECT COUNT(ss_item_sk) AS c, SUM(ss_quantity) AS s "
+              "FROM store_sales",
+    "agg_ws": "SELECT COUNT(ws_item_sk) AS c, SUM(ws_quantity) AS s "
+              "FROM web_sales",
+    "join_ss": "SELECT d_year, COUNT(ss_item_sk) AS c "
+               "FROM store_sales JOIN date_dim "
+               "ON ss_sold_date_sk = d_date_sk "
+               "WHERE d_moy = 11 GROUP BY d_year",
+}
+
+# the unpinned ride-along that exercises the spine cache across epochs
+SPINE_QUERY = ("SELECT ss_store_sk, SUM(ss_quantity) AS s "
+               "FROM store_sales GROUP BY ss_store_sk")
+
+CHAOS_FAULTS = "ingest.commit:transient:1.0:seedI:times=1"
+
+
+def digest(table) -> str:
+    """Order-insensitive content hash of an engine result table: rows
+    stringified (nulls as NULL), sorted, hashed."""
+    import numpy as np
+    cols = {}
+    for name, col in table.columns.items():
+        arr = np.asarray(col.data)
+        if col.dictionary is not None:
+            arr = np.asarray(col.dictionary)[arr]
+        vals = arr.astype(str).astype(object)
+        if col.valid is not None:
+            vals[~np.asarray(col.valid)] = "NULL"
+        cols[name] = vals
+    names = sorted(cols)
+    rows = sorted(zip(*(cols[k] for k in names))) if names else []
+    h = hashlib.sha256()
+    h.update("|".join(names).encode())
+    for r in rows:
+        h.update(("\x1f".join(r) + "\x1e").encode())
+    return h.hexdigest()[:24]
+
+
+def run_queries(sess, pin=None) -> dict:
+    return {name: digest(sess.sql(text, pin=pin))
+            for name, text in QUERIES.items()}
+
+
+def assert_no_torn(warehouse: str) -> None:
+    from ndstpu.io import lake
+    for t in lake.lake_tables(warehouse):
+        root = os.path.join(warehouse, t)
+        v = lake.current_version(root)          # CURRENT parses
+        assert lake.read(root, version=v).num_rows >= 0, t
+
+
+def make_session(warehouse: str):
+    from ndstpu.engine import spine as spine_mod
+    from ndstpu.engine.session import Session
+    from ndstpu.io import loader
+    sess = Session(loader.load_catalog(warehouse), warehouse=warehouse)
+    sess.spine_cache = spine_mod.SpineCache(64 << 20, None)
+    return sess
+
+
+def make_batches(sess, refresh_dir: str):
+    from ndstpu.harness import maintenance
+    maintenance.register_staging_views(sess, refresh_dir)
+    queries = maintenance.get_maintenance_queries(sess, FUNCS)
+
+    def sql_batch(stmts):
+        def apply():
+            for s in stmts:
+                sess.sql(s)
+        return apply
+    return [(fn, sql_batch(queries[fn])) for fn in FUNCS]
+
+
+def interleaved_run(warehouse: str, refresh_dir: str,
+                    observations: dict) -> dict:
+    """Phase 1/3 body: 2 pinned-query threads + 1 ingest thread over
+    one shared Session.  Records digest observations keyed
+    (epoch, query) into ``observations`` and returns run stats."""
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    sess = make_session(warehouse)
+    batches = make_batches(sess, refresh_dir)
+    ing = MicroBatchIngestor(warehouse, sess=sess)
+    done = threading.Event()
+    errors = []
+    obs_lock = threading.Lock()
+
+    def observe(pin, results):
+        with obs_lock:
+            for name, dig in results.items():
+                key = (pin.epoch, name)
+                prev = observations.setdefault(key, dig)
+                assert prev == dig, \
+                    f"same-epoch divergence at {key}: {prev} vs {dig}"
+
+    def query_worker():
+        try:
+            while True:
+                pin = sess.pin_snapshot()
+                observe(pin, run_queries(sess, pin=pin))
+                sess.sql(SPINE_QUERY)  # unpinned: drives spine churn
+                if done.is_set():
+                    break
+        except BaseException as e:                    # noqa: BLE001
+            errors.append(e)
+            done.set()
+
+    def ingest_worker():
+        try:
+            ing.run(batches, batch_pause_s=0.3)
+        except BaseException as e:                    # noqa: BLE001
+            errors.append(e)
+        finally:
+            done.set()
+
+    threads = [threading.Thread(target=query_worker) for _ in range(2)]
+    threads.append(threading.Thread(target=ingest_worker))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    if errors:
+        raise errors[0]
+    # one final pinned round so the post-ingest epoch is always observed
+    pin = sess.pin_snapshot()
+    observe(pin, run_queries(sess, pin=pin))
+    return {"final_epoch": pin.epoch,
+            "records": [r["batch"] for r in ing.records()
+                        if r.get("event") == "done"]}
+
+
+def quiesced_truth(warehouse: str, refresh_dir: str) -> dict:
+    """Phase 2: replay the same batches one at a time, recording every
+    boundary epoch's digests — the ground truth."""
+    from ndstpu.io import lake
+    sess = make_session(warehouse)
+    batches = make_batches(sess, refresh_dir)
+    truth = {}
+    epochs = [lake.warehouse_epoch(warehouse)]
+    truth[epochs[-1]] = run_queries(sess)
+    for _name, apply in batches:
+        apply()
+        epochs.append(lake.warehouse_epoch(warehouse))
+        truth[epochs[-1]] = run_queries(sess)
+    return {"epochs": epochs, "digests": truth}
+
+
+def check_against_truth(observations: dict, truth: dict,
+                        what: str) -> None:
+    for (epoch, name), dig in sorted(observations.items()):
+        assert epoch in truth["digests"], \
+            f"{what}: observed epoch {epoch} is not a batch boundary " \
+            f"(truth epochs: {truth['epochs']})"
+        want = truth["digests"][epoch][name]
+        assert dig == want, \
+            f"{what}: {name}@{epoch} = {dig}, quiesced truth {want}"
+
+
+def counters() -> dict:
+    from ndstpu import obs
+    return dict(obs.counters_snapshot())
+
+
+def counter_delta(before: dict, after: dict, name: str) -> float:
+    return after.get(name, 0) - before.get(name, 0)
+
+
+def run_until_killed(cmd, env, log: pathlib.Path, trigger, what: str,
+                     timeout_s: float = 600.0) -> None:
+    print("+", " ".join(map(str, cmd)), f"   [kill on: {what}]",
+          flush=True)
+    with open(log, "w") as f:
+        p = subprocess.Popen([str(c) for c in cmd], env=env, stdout=f,
+                             stderr=subprocess.STDOUT,
+                             start_new_session=True)
+        t0 = time.time()
+        try:
+            while not trigger():
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"ingest exited rc={p.returncode} before "
+                        f"'{what}':\n{log.read_text()[-4000:]}")
+                if time.time() - t0 > timeout_s:
+                    raise AssertionError(f"timed out waiting for {what}")
+                time.sleep(0.05)
+        finally:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        p.wait()
+    print(f"  -> SIGKILLed after {time.time() - t0:.1f}s on: {what}",
+          flush=True)
+
+
+def run_logged(cmd, env, log: pathlib.Path) -> None:
+    print("+", " ".join(map(str, cmd)), flush=True)
+    with open(log, "w") as f:
+        rc = subprocess.run([str(c) for c in cmd], env=env, stdout=f,
+                            stderr=subprocess.STDOUT,
+                            timeout=600).returncode
+    assert rc == 0, f"rc={rc}:\n{log.read_text()[-4000:]}"
+
+
+def table_contents_equal(wh_a: str, wh_b: str) -> None:
+    from ndstpu.io import lake
+    tables = lake.lake_tables(wh_a)
+    assert tables == lake.lake_tables(wh_b)
+    for t in tables:
+        a = lake.read(os.path.join(wh_a, t))
+        b = lake.read(os.path.join(wh_b, t))
+        order = [(c, "ascending") for c in a.column_names]
+        assert a.sort_by(order).equals(b.sort_by(order)), \
+            f"{t}: contents diverge between {wh_a} and {wh_b}"
+
+
+def main() -> int:
+    from ndstpu.faults import injector
+    injector.uninstall()  # phases install their own specs
+    work = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_ingest"))
+    raw, raw_1 = work / "raw", work / "raw_1"
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("NDSTPU_FAULTS", None)
+
+    # ---- phase 0: corpus + pristine copies --------------------------
+    run_logged([sys.executable, "-m", "ndstpu.datagen.driver", "local",
+                "0.002", "2", raw], env, work / "gen.log")
+    run_logged([sys.executable, "-m", "ndstpu.datagen.driver", "local",
+                "0.002", "2", raw_1, "--update", "1"],
+               env, work / "gen1.log")
+    run_logged([sys.executable, "-m", "ndstpu.io.transcode",
+                "--input_prefix", raw, "--output_prefix", work / "wh",
+                "--report_file", work / "load.txt",
+                "--output_format", "ndslake"], env, work / "load.log")
+    for name in ("wh_truth", "wh_chaos", "wh_kill", "wh_kill_ctl"):
+        shutil.copytree(work / "wh", work / name)
+
+    # ---- phase 2 first: quiesced ground truth -----------------------
+    truth = quiesced_truth(str(work / "wh_truth"), str(raw_1))
+    assert len(truth["epochs"]) == len(FUNCS) + 1
+    print(f"truth: {len(truth['epochs'])} boundary epochs "
+          f"{truth['epochs']}", flush=True)
+
+    # ---- phase 1: interleaved ingest + pinned queries ---------------
+    c0 = counters()
+    observations: dict = {}
+    live = interleaved_run(str(work / "wh"), str(raw_1), observations)
+    c1 = counters()
+    check_against_truth(observations, truth, "interleaved")
+    assert_no_torn(str(work / "wh"))
+    seen_epochs = sorted({e for e, _ in observations})
+    assert len(seen_epochs) >= 2, \
+        f"interleaving observed only {seen_epochs} — no epoch motion"
+    assert live["final_epoch"] == truth["epochs"][-1]
+    stale = counter_delta(c0, c1, "engine.snapshot.stale_drops")
+    pinned = counter_delta(c0, c1, "engine.snapshot.pinned")
+    commits = counter_delta(c0, c1, "engine.ingest.commits")
+    assert stale >= 1, "no stale spine drop across an ingest commit"
+    assert pinned >= len(observations) / len(QUERIES)
+    assert commits >= len(FUNCS)
+    print(f"interleaved: {len(observations)} observations over "
+          f"{len(seen_epochs)} epochs, {int(commits)} commits, "
+          f"stale_drops={int(stale)}", flush=True)
+
+    # ---- phase 3: chaos — injected commit fault, same differential --
+    injector.install(CHAOS_FAULTS)
+    try:
+        chaos_obs: dict = {}
+        chaos = interleaved_run(str(work / "wh_chaos"), str(raw_1),
+                                chaos_obs)
+    finally:
+        injector.uninstall()
+    c2 = counters()
+    check_against_truth(chaos_obs, truth, "chaos")
+    assert_no_torn(str(work / "wh_chaos"))
+    retries = counter_delta(c1, c2, "engine.ingest.retries")
+    assert retries >= 1, \
+        "injected ingest.commit fault was never retried"
+    assert chaos["final_epoch"] == truth["epochs"][-1], \
+        "chaos run landed on a different final epoch than the " \
+        "quiesced sequence — retraction did not restore the trajectory"
+    table_contents_equal(str(work / "wh_chaos"), str(work / "wh_truth"))
+    print(f"chaos: retries={int(retries)}, final epoch matches truth",
+          flush=True)
+
+    # ---- phase 4: SIGKILL mid-ingest, resume to identical snapshot --
+    ingest_cmd = [sys.executable, "-m", "ndstpu.harness.ingest",
+                  work / "wh_kill", "--refresh_data_path", raw_1,
+                  "--funcs", ",".join(FUNCS)]
+    ctl_cmd = list(ingest_cmd)
+    ctl_cmd[3] = work / "wh_kill_ctl"
+    run_logged(ctl_cmd, env, work / "kill_ctl.log")
+    kill_log = work / "kill.log"
+    run_until_killed(
+        ingest_cmd + ["--batch_pause_s", "2.0"], env, kill_log,
+        trigger=lambda: "done (attempts=" in
+        (kill_log.read_text() if kill_log.exists() else ""),
+        what="first journaled-done ingest batch")
+    assert_no_torn(str(work / "wh_kill"))       # old or new, never torn
+    run_logged(ingest_cmd + ["--resume"], env, work / "kill_resume.log")
+    assert "journaled done" in (work / "kill_resume.log").read_text()
+
+    from ndstpu.io import lake
+    vk = lake.versions_vector(str(work / "wh_kill"))
+    vc = lake.versions_vector(str(work / "wh_kill_ctl"))
+    assert vk == vc, \
+        f"resumed versions {vk} != uninterrupted control {vc}"
+    ek = lake.warehouse_epoch(str(work / "wh_kill"))
+    assert ek == lake.warehouse_epoch(str(work / "wh_kill_ctl"))
+    assert ek == truth["epochs"][-1]
+    table_contents_equal(str(work / "wh_kill"), str(work / "wh_kill_ctl"))
+    print(f"sigkill: resumed to identical final snapshot "
+          f"(epoch {ek}, versions match control)", flush=True)
+
+    # ---- artifact ---------------------------------------------------
+    diff = {
+        "format": "ndstpu-ingest-diff-v1",
+        "funcs": FUNCS,
+        "queries": sorted(QUERIES),
+        "truth_epochs": truth["epochs"],
+        "interleaved": {
+            "observations": len(observations),
+            "epochs_observed": seen_epochs,
+            "commits": int(commits),
+            "stale_drops": int(stale),
+            "pinned": int(pinned),
+        },
+        "chaos": {
+            "retries": int(retries),
+            "final_epoch": chaos["final_epoch"],
+            "epochs_observed": sorted({e for e, _ in chaos_obs}),
+        },
+        "sigkill": {
+            "final_versions": vk,
+            "final_epoch": ek,
+        },
+    }
+    (work / "INGEST_DIFF.json").write_text(json.dumps(diff, indent=1))
+    print(f"ingest smoke OK: interleaved == quiesced across "
+          f"{len(truth['epochs'])} epochs, chaos retried, SIGKILL "
+          f"resumed bit-exact (INGEST_DIFF: {work / 'INGEST_DIFF.json'})")
+    shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

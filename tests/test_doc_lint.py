@@ -108,3 +108,17 @@ def test_run_state_citation_is_recognized_but_runtime_exempt(tmp_path):
     assert len(findings) == 1
     assert "GHOST" in findings[0]
     assert not any("RUN_STATE" in f for f in findings)
+
+
+def test_ingest_diff_citation_is_recognized_but_runtime_exempt(tmp_path):
+    """`INGEST_DIFF.json` is the ingest differential's per-run artifact
+    (scripts/ingest_smoke.py): recognized as a citation, exempt from
+    the committed-file existence check."""
+    text = ("the ingest smoke writes `INGEST_DIFF.json` per run\n"
+            "and cites `docs/GHOST.json` for numbers\n")
+    (tmp_path / "docs").mkdir()
+    findings = artifact_lint.lint_text(text, str(tmp_path), doc="d.md")
+    assert len(findings) == 1 and "GHOST" in findings[0]
+    assert not any("INGEST_DIFF" in f for f in findings)
+    assert any("INGEST_DIFF.json" in m.group(0)
+               for m in artifact_lint.CITED_RE.finditer(text))

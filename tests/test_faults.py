@@ -426,3 +426,161 @@ def test_throughput_restart_exhausted_is_permanent(tmp_path):
         rec = json.load(f)["streams"][0]
     assert rec["restarts"] == 1 and rec["returncode"] == 4
     assert rec["taxonomy"] == taxonomy.PERMANENT
+
+
+# ------------------------------------------- crash-consistent ingest
+
+
+def _tiny_lake(tmp_path, tables=("alpha", "beta"), fmt="ndslake"):
+    import numpy as np
+    import pyarrow as pa
+
+    from ndstpu.io import lake
+    wh = str(tmp_path / "wh")
+    os.makedirs(wh, exist_ok=True)
+    for t in tables:
+        at = pa.table({"k": np.arange(6, dtype=np.int64)})
+        lake.create_table(fmt, str(tmp_path / "wh" / t), at)
+    return wh
+
+
+def test_ingest_commit_fault_leaves_old_state_current(tmp_path):
+    """An injected ingest.commit fault fires with the manifest written
+    but CURRENT unpublished: the table stays at the OLD snapshot —
+    never torn — and GC restores the version numbering."""
+    import pyarrow as pa
+
+    from ndstpu.io import lake
+    wh = _tiny_lake(tmp_path, tables=("alpha",))
+    root = os.path.join(wh, "alpha")
+    v0 = lake.current_version(root)
+
+    faults.install("ingest.commit:transient:1.0:times=1")
+    with pytest.raises(faults.InjectedTransient):
+        lake.append(root, pa.table({"k": pa.array([99])}))
+    faults.uninstall()
+
+    # old snapshot is still CURRENT and fully readable: not torn
+    assert lake.current_version(root) == v0
+    assert lake.read(root).num_rows == 6
+    # the unpublished manifest is GC-able garbage, not corruption
+    removed = lake.gc_orphan_manifests(root)
+    assert removed, "fault before publish left no orphan manifest"
+    lake.append(root, pa.table({"k": pa.array([99])}))
+    assert lake.current_version(root) == v0 + 1  # clean-run numbering
+
+
+def test_commit_conflict_classified_transient():
+    from ndstpu.io.commit import CommitConflict
+    exc = CommitConflict("/wh/t", 3, 5)
+    assert taxonomy.classify(exc) == taxonomy.TRANSIENT
+    assert exc.expected == 3 and exc.found == 5
+
+
+def test_ingestor_journals_intent_and_done(tmp_path):
+    import pyarrow as pa
+
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import lake
+    wh = _tiny_lake(tmp_path)
+    ing = MicroBatchIngestor(wh)
+
+    def batch():
+        for t in ("alpha", "beta"):
+            root = os.path.join(wh, t)
+            lake.append(root, pa.table({"k": pa.array([7])}))
+
+    rec = ing.apply_batch("b0", batch)
+    assert rec["attempts"] == 1
+    events = [r["event"] for r in ing.records()]
+    assert events == ["intent", "done"]
+    assert ing.records()[0]["pre_versions"] == {"alpha": 0, "beta": 0}
+    assert rec["post_versions"] == {"alpha": 1, "beta": 1}
+    assert ing.pending_intent() is None
+    assert ing.done_funcs() == ["b0"]
+
+
+def test_ingestor_retries_injected_commit_fault(tmp_path):
+    """A transient ingest.commit fault inside a batch is absorbed by
+    retract-and-retry, landing on the same versions as a clean run."""
+    import pyarrow as pa
+
+    from ndstpu import obs
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import lake
+    wh = _tiny_lake(tmp_path)
+    ing = MicroBatchIngestor(wh)
+
+    def batch():
+        for t in ("alpha", "beta"):
+            lake.append(os.path.join(wh, t),
+                        pa.table({"k": pa.array([7])}))
+
+    before = dict(obs.counters_snapshot())
+    faults.install("ingest.commit:transient:1.0:times=1")
+    try:
+        rec = ing.apply_batch("b0", batch)
+    finally:
+        faults.uninstall()
+    assert rec["attempts"] == 2
+    assert rec["post_versions"] == {"alpha": 1, "beta": 1}
+    after = dict(obs.counters_snapshot())
+    assert after.get("engine.ingest.retries", 0) - \
+        before.get("engine.ingest.retries", 0) >= 1
+
+
+def test_ingestor_resume_retracts_crashed_batch(tmp_path):
+    """intent-without-done + partially committed tables == crash
+    mid-batch: resume() retracts to the recorded pre-versions (no
+    rollback snapshot — the clean-run version trajectory survives)."""
+    import pyarrow as pa
+
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import lake
+    wh = _tiny_lake(tmp_path)
+    ing = MicroBatchIngestor(wh)
+
+    class Crash(RuntimeError):
+        pass
+
+    def partial():
+        lake.append(os.path.join(wh, "alpha"),
+                    pa.table({"k": pa.array([7])}))
+        raise Crash("died mid-batch")
+
+    with pytest.raises(Crash):
+        ing.apply_batch("b0", partial)
+    assert lake.versions_vector(wh) == {"alpha": 1, "beta": 0}
+    assert ing.pending_intent() is not None
+
+    assert ing.resume() == "b0"  # the batch must be re-applied
+    assert lake.versions_vector(wh) == {"alpha": 0, "beta": 0}
+    assert lake.read(os.path.join(wh, "alpha")).num_rows == 6
+    assert [r["event"] for r in ing.records()] == \
+        ["intent", "rolled_back"]
+    # a clean journal resumes to nothing
+    assert ing.resume() is None
+
+
+def test_ingestor_run_skips_journaled_done(tmp_path):
+    import pyarrow as pa
+
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import lake
+    wh = _tiny_lake(tmp_path, tables=("alpha",))
+    applied = []
+
+    def mk(name):
+        def apply():
+            applied.append(name)
+            lake.append(os.path.join(wh, "alpha"),
+                        pa.table({"k": pa.array([1])}))
+        return apply
+
+    ing = MicroBatchIngestor(wh)
+    ing.run([("b0", mk("b0"))])
+    # a fresh ingestor (new process) over the same journal skips b0
+    ing2 = MicroBatchIngestor(wh)
+    ing2.run([("b0", mk("b0")), ("b1", mk("b1"))], resume=True)
+    assert applied == ["b0", "b1"]
+    assert lake.versions_vector(wh) == {"alpha": 2}

@@ -246,3 +246,89 @@ def test_ledger_estimator_feeds_queue():
     assert q.cost("query1") == 2.5
     assert q.cost("queryX") == progress.DEFAULT_COST_S
     assert progress.ledger_estimator(None)("query1") is None
+
+
+# ------------------------------------------- snapshot-epoch awareness
+
+
+def _epoch_ledger():
+    """Warm baselines under epoch eAAA plus one unstamped legacy row."""
+    led = ledger_mod.Ledger(path=None)
+    led.record_query("query1", 2.0, 0.0, 1.9, engine="cpu",
+                     scale_factor="1", extra={"snapshot_epoch": "eAAA"})
+    led.record_query("query2", 3.0, 0.0, 2.9, engine="cpu",
+                     scale_factor="1", extra={"snapshot_epoch": "eAAA"})
+    led.record_query("query3", 4.0, 0.0, 3.9, engine="cpu",
+                     scale_factor="1")  # legacy: no epoch stamp
+    return led
+
+
+def test_best_warm_scopes_to_snapshot_epoch():
+    led = _epoch_ledger()
+    # same epoch: baseline applies
+    assert led.best_warm("query1", engine="cpu", scale_factor="1",
+                         snapshot_epoch="eAAA") == 2.0
+    # other epoch: the data changed — the eAAA wall must not be used
+    assert led.best_warm("query1", engine="cpu", scale_factor="1",
+                         snapshot_epoch="eBBB") is None
+    # no epoch given (legacy caller): everything stays comparable
+    assert led.best_warm("query1", engine="cpu",
+                         scale_factor="1") == 2.0
+    # unstamped legacy entries qualify under ANY epoch
+    assert led.best_warm("query3", engine="cpu", scale_factor="1",
+                         snapshot_epoch="eBBB") == 4.0
+
+
+def test_warm_epochs_lists_stamped_epochs():
+    led = _epoch_ledger()
+    led.record_query("query1", 2.5, 0.0, 2.4, engine="cpu",
+                     scale_factor="1", extra={"snapshot_epoch": "eCCC"})
+    assert led.warm_epochs("query1", engine="cpu",
+                           scale_factor="1") == {"eAAA", "eCCC"}
+    # legacy unstamped entries contribute no epoch
+    assert led.warm_epochs("query3", engine="cpu",
+                           scale_factor="1") == set()
+
+
+def test_sentinel_data_changed_not_regressed_across_epochs():
+    """A warm wall 10x the baseline under a DIFFERENT snapshot epoch
+    is the data changing, not the engine regressing."""
+    led = _epoch_ledger()
+    run = [{"query": "query1", "wall_s": 20.0, "compile_s": 0.0,
+            "execute_s": 19.9}]
+    res = sentinel.classify_run(run, led, engine="cpu",
+                                scale_factor="1",
+                                snapshot_epoch="eBBB")
+    v = res["verdicts"][0]
+    assert v["verdict"] == "data-changed"
+    assert "eAAA" in v["reason"]
+    assert res["regressions"] == []
+    # the SAME wall under the SAME epoch is a genuine regression
+    res2 = sentinel.classify_run(run, led, engine="cpu",
+                                 scale_factor="1",
+                                 snapshot_epoch="eAAA")
+    assert res2["verdicts"][0]["verdict"] == "regressed"
+
+
+def test_sentinel_epoch_unstamped_stays_comparable():
+    """Legacy ledgers (no epoch stamps) keep classifying normally under
+    an epoch-stamped run — no data-changed false positives."""
+    led = ledger_mod.Ledger(path=None)
+    led.record_query("query1", 2.0, 0.0, 1.9, engine="cpu",
+                     scale_factor="1")
+    run = [{"query": "query1", "wall_s": 2.1, "compile_s": 0.0,
+            "execute_s": 2.0}]
+    res = sentinel.classify_run(run, led, engine="cpu",
+                                scale_factor="1",
+                                snapshot_epoch="eNEW")
+    assert res["verdicts"][0]["verdict"] == "flat"
+
+
+def test_sentinel_genuinely_new_query_stays_new_under_epoch():
+    led = _epoch_ledger()
+    run = [{"query": "query9", "wall_s": 1.0, "compile_s": 0.0,
+            "execute_s": 0.9}]
+    res = sentinel.classify_run(run, led, engine="cpu",
+                                scale_factor="1",
+                                snapshot_epoch="eBBB")
+    assert res["verdicts"][0]["verdict"] == "new"
