@@ -467,6 +467,28 @@ class _Canon:
                     oc = self._expr(other, path)
                     return ex.BinOp(op, oc, pnode) if swapped \
                         else ex.BinOp(op, pnode, oc)
+                if ot is not None and ot.kind == "date" and \
+                        not self.force_shape:
+                    # bare date-string vs a date column: both backends'
+                    # implicit string->date compare coercion parses it,
+                    # so bind the parsed days as a DATE slot — the same
+                    # shape as the cast-folded date literal, closing the
+                    # '2002-4-01'-style NDS403 cache-key residuals
+                    try:
+                        days = columnar.parse_date_days(lit.value)
+                    except ValueError:
+                        days = None
+                    if days is not None:
+                        idx = self._slot(
+                            "bind", days, DATE, path,
+                            reason="date string compare (implicit "
+                                   "string->date coercion)",
+                            column=self._source_column(other),
+                            orig_ctype=None, tag="date")
+                        pnode = ex.Param(idx, DATE)
+                        oc = self._expr(other, path)
+                        return ex.BinOp(op, oc, pnode) if swapped \
+                            else ex.BinOp(op, pnode, oc)
             # date +/- int literal lives below; comparisons recurse with
             # source-column attribution for the binding report
             left = self._cmp_side(e.left, e.right, path)

@@ -79,6 +79,9 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NDS311": ("warning", "configured chunked streaming fell back to the "
                           "single-chip whole-fact path (the fact must fit "
                           "HBM resident; spmd_chunk_rows is ignored there)"),
+    "NDS312": ("info", "string join key shards on frozen global-dictionary "
+                       "codes (no build-dictionary translation; "
+                       "NDSTPU_GLOBAL_DICTS=0 restores the translate path)"),
     # -- NDS4xx canonicalization / parameter lifting ----------------------
     "NDS401": ("info", "shape-affecting literal: value feeds static shape "
                        "or capacity planning (LIMIT, interval width, "

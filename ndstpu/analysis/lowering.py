@@ -576,6 +576,16 @@ class LoweringAuditor:
                     self._emit("NDS307", f"{t.kind} join key is not "
                                "shardable on the spine",
                                f"{npath}/keys[{i}]")
+                elif t.known and t.kind == "string":
+                    from ndstpu.io import gdict
+                    if gdict.enabled():
+                        # static mirror of dplan._probe_keys' identity
+                        # path: with warehouse-wide frozen dictionaries
+                        # both sides share one code space and the key
+                        # shards on raw codes
+                        self._emit("NDS312", "string join key shards "
+                                   "on frozen global-dictionary codes",
+                                   f"{npath}/keys[{i}]")
             if any(isinstance(n, lp.Scan) and
                    n.table in SPMD_FACT_TABLES for n in build.walk()):
                 shuffle += 1

@@ -16,7 +16,12 @@ Design (TPU-first):
 * String dictionaries are sorted, so ``<``, ``>``, ORDER BY and range
   predicates operate directly on codes.  Cross-table string equality
   (joins) goes through a host-side code translation of the two small
-  dictionaries (`translate_codes`).
+  dictionaries (`translate_codes`) — unless both sides carry the SAME
+  frozen warehouse-wide dictionary (``Column.gdict``, ndstpu/io/gdict.py),
+  in which case codes compare directly with no translation at all.
+  Columns loaded from a transcoded warehouse encode against the table's
+  global dictionary sidecar, so codes are stable across chunks, shards
+  and snapshots.
 
 * NULL is carried as a validity mask (True = present).  String NULLs are
   additionally code ``-1``.
@@ -87,6 +92,9 @@ class Column:
     ctype: DType
     valid: Optional[np.ndarray] = None  # bool mask, None == all valid
     dictionary: Optional[np.ndarray] = None  # object array, sorted, for string
+    # frozen warehouse-wide dictionary this column's codes live in
+    # (io.gdict.GlobalDict); None for ad-hoc per-call dictionaries
+    gdict: Optional[object] = None
 
     def __post_init__(self):
         if self.ctype.kind == "string" and self.dictionary is None:
@@ -184,11 +192,12 @@ class Column:
         valid = self.valid[indices] if self.valid is not None else None
         if extra_valid is not None:
             valid = extra_valid if valid is None else (valid & extra_valid)
-        return Column(data, self.ctype, valid, self.dictionary)
+        return Column(data, self.ctype, valid, self.dictionary, self.gdict)
 
     def filter(self, mask: np.ndarray) -> "Column":
         valid = self.valid[mask] if self.valid is not None else None
-        return Column(self.data[mask], self.ctype, valid, self.dictionary)
+        return Column(self.data[mask], self.ctype, valid, self.dictionary,
+                      self.gdict)
 
 
 def translate_codes(src: Column, dst_dictionary: np.ndarray) -> np.ndarray:
@@ -259,7 +268,7 @@ class Table:
     def head(self, n: int) -> "Table":
         return Table({name: Column(c.data[:n], c.ctype,
                                    None if c.valid is None else c.valid[:n],
-                                   c.dictionary)
+                                   c.dictionary, c.gdict)
                       for name, c in self.columns.items()})
 
     def to_pydict(self) -> Dict[str, List]:
@@ -280,7 +289,20 @@ class Table:
         for n in names:
             cols = [t.column(n) for t in tables]
             ct = cols[0].ctype
-            if ct.kind == "string":
+            if ct.kind == "string" and len(cols) > 1 and all(
+                    len(c.dictionary) == len(cols[0].dictionary)
+                    and np.array_equal(c.dictionary, cols[0].dictionary)
+                    for c in cols[1:]):
+                # shared code space (same frozen global dictionary, or
+                # simply identical dictionaries): concat codes directly
+                valid = np.concatenate([c.validity() for c in cols])
+                out[n] = Column(np.concatenate([c.data for c in cols]), ct,
+                                None if valid.all() else valid,
+                                cols[0].dictionary,
+                                cols[0].gdict if all(
+                                    c.gdict is cols[0].gdict
+                                    for c in cols) else None)
+            elif ct.kind == "string":
                 merged = merge_dictionaries(cols)
                 datas, valids = [], []
                 for c in cols:
@@ -343,9 +365,18 @@ def _coerce_to_spec(arr, spec_dtype: DType):
     return arr
 
 
-def _encode_strings_arrow(arr) -> Column:
+def _encode_strings_arrow(arr, global_dict=None) -> Column:
     """Dictionary-encode an arrow string array with a *sorted* dictionary,
-    all in arrow/numpy (no per-row python)."""
+    all in arrow/numpy (no per-row python).
+
+    With ``global_dict`` (an io.gdict.GlobalDict), codes are emitted
+    against the frozen warehouse-wide dictionary instead of the values
+    this call happens to see, so every chunk/shard/snapshot of the table
+    shares one code space.  A value absent from the global dictionary
+    (stale sidecar) falls back to a local per-call dictionary — callers
+    that REQUIRE the shared code space (chunk sources) check
+    ``Column.gdict`` after the fact.
+    """
     import pyarrow as pa
     import pyarrow.compute as pc
 
@@ -357,28 +388,47 @@ def _encode_strings_arrow(arr) -> Column:
     null_mask = np.asarray(arr.is_null())
     valid = ~null_mask if null_mask.any() else None
     if len(dict_vals) == 0:
+        gdv = None if global_dict is None else global_dict.values
         return Column(np.full(len(codes), -1, np.int32), STRING, valid,
-                      np.empty(0, dtype=object))
+                      np.empty(0, dtype=object) if gdv is None else gdv,
+                      global_dict)
     order = np.argsort(dict_vals.astype(str), kind="stable")
     sorted_dict = dict_vals[order]
     remap = np.empty(len(order), dtype=np.int32)
     remap[order] = np.arange(len(order), dtype=np.int32)
+    if global_dict is not None:
+        # remap local sorted positions into the frozen global code space
+        gvals = global_dict.values.astype(str)
+        pos = np.searchsorted(gvals, sorted_dict.astype(str))
+        posc = np.clip(pos, 0, max(len(gvals) - 1, 0))
+        hit = (gvals[posc] == sorted_dict.astype(str)) if len(gvals) else \
+            np.zeros(len(sorted_dict), dtype=bool)
+        if bool(hit.all()):
+            remap = posc.astype(np.int32)[remap]
+            sorted_dict = global_dict.values
+        else:
+            from ndstpu import obs
+            obs.inc("engine.dict.misses", int((~hit).sum()))
+            global_dict = None  # value outside the sidecar: local encode
     out = np.full(len(codes), -1, dtype=np.int32)
     ok = ~np.isnan(codes) if codes.dtype.kind == "f" else np.ones(
         len(codes), dtype=bool)
     if valid is not None:
         ok &= valid
     out[ok] = remap[codes[ok].astype(np.int64)]
-    return Column(out, STRING, valid, sorted_dict)
+    return Column(out, STRING, valid, sorted_dict, global_dict)
 
 
-def from_arrow(at, schema: Optional[TableSchema] = None) -> Table:
+def from_arrow(at, schema: Optional[TableSchema] = None,
+               gdicts: Optional[Dict[str, object]] = None) -> Table:
     """pyarrow.Table -> engine Table.
 
     Numeric/date columns map directly; decimals become scaled int64 using the
     schema's (p,s) (or the arrow type's scale); strings are dictionary-encoded
     with a sorted dictionary.  When a TableSchema is given, arrow columns are
-    first coerced toward the declared types (csv/json round-trips).
+    first coerced toward the declared types (csv/json round-trips).  When
+    ``gdicts`` maps column names to io.gdict.GlobalDict, string columns are
+    encoded against those frozen warehouse-wide dictionaries.
     """
     import pyarrow as pa
     import pyarrow.compute as pc
@@ -427,7 +477,8 @@ def from_arrow(at, schema: Optional[TableSchema] = None) -> Table:
         else:  # strings (incl. dictionary<string>)
             if pa.types.is_dictionary(typ):
                 arr = arr.cast(typ.value_type)
-            cols[name] = _encode_strings_arrow(arr)
+            cols[name] = _encode_strings_arrow(
+                arr, gdicts.get(name) if gdicts else None)
     return Table(cols)
 
 

@@ -462,6 +462,37 @@ def cast_column(c: Column, target: DType) -> Column:
     raise NotImplementedError(f"cast {c.ctype} -> {target}")
 
 
+def parse_dictionary_days(dictionary) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a string dictionary's entries as dates: (days, parse_ok)
+    per entry.  Shared by both backends' implicit string->date compare
+    coercion so their NULL semantics for unparseable values agree."""
+    n = len(dictionary) if dictionary is not None else 0
+    days = np.zeros(n, dtype=np.int32)
+    ok = np.ones(n, dtype=bool)
+    for i in range(n):
+        try:
+            days[i] = columnar.parse_date_days(str(dictionary[i]))
+        except ValueError:
+            ok[i] = False
+    return days, ok
+
+
+def string_to_date_column(c: Column) -> Column:
+    """Implicit string->date coercion for compares: decode via the
+    (small) dictionary, unparseable entries and negative codes become
+    NULL."""
+    days, ok = parse_dictionary_days(c.dictionary)
+    codes_ok = c.data >= 0
+    if len(days):
+        idx = np.clip(c.data, 0, len(days) - 1)
+        out = np.where(codes_ok, days[idx], np.int32(0))
+        valid = c.validity() & codes_ok & ok[idx]
+    else:
+        out = np.zeros(len(c.data), dtype=np.int32)
+        valid = np.zeros(len(c.data), dtype=bool)
+    return Column(out.astype(np.int32), DATE, valid)
+
+
 def _to_str(x, ct: DType) -> str:
     if ct.kind == "decimal":
         return f"{x:.{ct.scale}f}"
@@ -613,6 +644,15 @@ class Evaluator:
         return lc.data, rc.data
 
     def _compare(self, op: str, lc: Column, rc: Column) -> Column:
+        # implicit string->date coercion (Spark semantics): a string
+        # compared against a date parses as a date, unparseable -> NULL.
+        # Without it both backends fell through to comparing date days
+        # against raw dictionary codes — `d_date >= '2002-4-01'` matched
+        # every date since 1970 (the string's code is 0).
+        if lc.ctype.kind == "date" and rc.ctype.kind == "string":
+            rc = string_to_date_column(rc)
+        elif rc.ctype.kind == "date" and lc.ctype.kind == "string":
+            lc = string_to_date_column(lc)
         ld, rd = self._align_for_compare(lc, rc)
         if op == "=":
             data = ld == rd

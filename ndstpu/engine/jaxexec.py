@@ -740,6 +740,26 @@ def _pdict_hits(value, op: str, swapped: bool, dictionary) -> np.ndarray:
     return np.asarray(hits + [False], dtype=bool)
 
 
+def _gcode_np(value, dictionary) -> np.int32:
+    """Bind-time dictionary-code lookup for a scalar string parameter:
+    the code of ``value`` in the frozen sorted dictionary, or the miss
+    sentinel ``len(dictionary)`` — outside every real code AND distinct
+    from the -1/-2 NULL/translate-miss codes that appear in DATA, so
+    ``= miss`` is never true and ``<> miss`` holds for every present
+    row."""
+    from ndstpu import obs
+    obs.inc("engine.dict.lookups")
+    v = str(value)
+    n = len(dictionary)
+    if n:
+        pos = int(np.searchsorted(
+            np.asarray(dictionary).astype(str), v))
+        if pos < n and str(dictionary[pos]) == v:
+            return np.int32(pos)
+    obs.inc("engine.dict.misses")
+    return np.int32(n)
+
+
 def _pvec_np(values, ctype: DType) -> np.ndarray:
     """Coerced device-representation vector for a bound IN-list over a
     numeric/date operand (mirrors JEval._in_list's literal path: decimal
@@ -801,6 +821,17 @@ class _ParamCtx:
             self.spec.append(("pvec", slot, ctype))
         return jnp.asarray(_pvec_np(self.values[slot], ctype))
 
+    def str_code(self, slot: int, dictionary) -> jnp.ndarray:
+        """Scalar dict-code string parameter (=/<> against a frozen
+        global dictionary): one traced int32 instead of a len(dict)+1
+        hit table per binding."""
+        if self.mode == "trace":
+            return self.traced[f"g{self._pop('gcode')}"]
+        if self.record:
+            self.spec.append(("gcode", slot,
+                              np.asarray(dictionary, dtype=object)))
+        return jnp.asarray(_gcode_np(self.values[slot], dictionary))
+
 
 @contextlib.contextmanager
 def _params_bound(ctx: Optional[_ParamCtx]):
@@ -833,6 +864,9 @@ def _param_args_np(spec, binding: Optional[ex.ParamBinding]) -> dict:
             _tag, slot, op, swapped, dic = ent
             out[f"d{j}"] = _pdict_hits(binding.values[slot], op,
                                        swapped, dic)
+        elif ent[0] == "gcode":
+            _tag, slot, dic = ent
+            out[f"g{j}"] = _gcode_np(binding.values[slot], dic)
         else:
             _tag, slot, ctype = ent
             out[f"v{j}"] = _pvec_np(binding.values[slot], ctype)
@@ -1092,11 +1126,33 @@ class JEval:
         return lc.data, rc.data
 
     def _compare(self, op: str, lc: DCol, rc: DCol) -> DCol:
+        # implicit string->date coercion (Spark semantics), mirroring
+        # ex.Evaluator._compare so both backends stay bit-identical:
+        # without it a bare `d_date >= '2002-4-01'` compared date days
+        # against the literal's dictionary code
+        if lc.ctype.kind == "date" and rc.ctype.kind == "string":
+            rc = self._string_to_date(rc)
+        elif rc.ctype.kind == "date" and lc.ctype.kind == "string":
+            lc = self._string_to_date(lc)
         ld, rd = self._align_compare(lc, rc)
         data = {"=": lambda: ld == rd, "<>": lambda: ld != rd,
                 "<": lambda: ld < rd, "<=": lambda: ld <= rd,
                 ">": lambda: ld > rd, ">=": lambda: ld >= rd}[op]()
         return DCol(data, lc.valid & rc.valid, BOOL)
+
+    def _string_to_date(self, c: DCol) -> DCol:
+        """Parse string codes as dates through a host-parsed dictionary
+        table; unparseable entries and negative codes become NULL
+        (same table as ex.string_to_date_column)."""
+        days, ok = ex.parse_dictionary_days(c.dictionary)
+        if not len(days):
+            return DCol(jnp.zeros(self.cap, jnp.int32),
+                        jnp.zeros(self.cap, bool), DATE)
+        codes_ok = c.data >= 0
+        idx = jnp.clip(c.data, 0, len(days) - 1)
+        out = jnp.where(codes_ok, jnp.asarray(days)[idx], jnp.int32(0))
+        valid = c.valid & codes_ok & jnp.asarray(ok)[idx]
+        return DCol(out, valid, DATE)
 
     def _arith(self, op: str, lc: DCol, rc: DCol) -> DCol:
         lk, rk = lc.ctype.kind, rc.ctype.kind
@@ -1272,6 +1328,15 @@ class JEval:
                 if oc.ctype.kind != "string" or oc.dictionary is None:
                     raise Unsupported("string parameter vs non-dictionary"
                                       " operand", code="NDS206")
+                from ndstpu.io import gdict
+                if op in ("=", "<>") and gdict.enabled():
+                    # scalar dict-code param: the bound value resolves
+                    # to one frozen-dictionary code on the host (miss ->
+                    # len(dict) sentinel), so equality runs on raw codes
+                    # and every binding replays one traced scalar
+                    code = ctx.str_code(par.slot, oc.dictionary)
+                    eq = oc.data == code
+                    return DCol(eq if op == "=" else ~eq, oc.valid, BOOL)
                 table = ctx.str_table(par.slot, op, swapped,
                                       oc.dictionary)
                 return DCol(table[oc.data], oc.valid, BOOL)

@@ -55,15 +55,17 @@ def runtime_budget_bytes() -> Tuple[int, str]:
 
 def table_bytes(t) -> int:
     """Materialized size of a columnar.Table under the planner's model:
-    data + validity mask, plus a nominal 8 B/entry for string
-    dictionaries (object pointers; the decoded text lives host-side)."""
+    data + validity mask, plus the actual UTF-8 text bytes of string
+    dictionaries (8 B/entry only counted the object pointers, so wide
+    string spines silently overran the LRU budget)."""
+    from ndstpu.io.gdict import dictionary_nbytes
     n = 0
     for c in t.columns.values():
         n += int(c.data.nbytes)
         if c.valid is not None:
             n += int(c.valid.nbytes)
         if c.dictionary is not None:
-            n += 8 * len(c.dictionary)
+            n += dictionary_nbytes(c.dictionary) + 8 * len(c.dictionary)
     return n
 
 

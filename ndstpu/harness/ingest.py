@@ -56,7 +56,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ndstpu.faults import retry
-from ndstpu.io import atomic, lake
+from ndstpu.io import atomic, gdict, lake
 
 JOURNAL_RELPATH = os.path.join("_ingest", "INGEST_STATE.jsonl")
 
@@ -131,6 +131,10 @@ class MicroBatchIngestor:
                 continue
             if cur != pre:
                 lake.abort_to_version(root, pre)
+                # drop dictionary versions stamped past the retracted
+                # snapshot — a re-applied batch regrows them, keeping the
+                # dict-version trajectory identical to a clean run's
+                gdict.retract(root, pre)
                 touched.append(table)
                 self._reload(table)
         lake.gc_orphans(self.warehouse)
@@ -141,12 +145,39 @@ class MicroBatchIngestor:
             return
         from ndstpu import schema as nds_schema
         from ndstpu.engine import columnar
-        at = lake.read(os.path.join(self.warehouse, table))
+        root = os.path.join(self.warehouse, table)
+        at = lake.read(root)
         try:
             sch = nds_schema.get_schema(table)
         except KeyError:
             sch = None
-        self.sess.catalog.register(table, columnar.from_arrow(at, sch))
+        gds = gdict.table_dicts(root, table)
+        self.sess.catalog.register(
+            table, columnar.from_arrow(at, sch, gdicts=gds or None))
+
+    def _grow_dicts(self, pre: Dict[str, int],
+                    post: Dict[str, int]) -> None:
+        """Append-only global-dictionary growth for every table whose
+        lake version advanced in this batch.  Runs before the done
+        record inside the batch lock: a crash between commit and grow
+        leaves intent-without-done, and :meth:`_restore` retracts both
+        the lake commits and the dict versions stamped past them, so
+        dict versions ride snapshot versions exactly.  Pinned readers
+        keep selecting the dict entry matching their pinned snapshot;
+        only new loads see the grown value set."""
+        if not gdict.enabled():
+            return
+        for table, cur in sorted(post.items()):
+            if pre.get(table) == cur:
+                continue
+            root = os.path.join(self.warehouse, table)
+            grown = gdict.grow_for_table(root, table, table_version=cur)
+            if self.sess is not None and any(
+                    e.get("table_version") == cur
+                    for e in grown.values()):
+                # re-encode the live catalog entry against the grown
+                # dict so new (unpinned) queries shard on its codes
+                self._reload(table)
 
     # -- apply -----------------------------------------------------------
 
@@ -185,8 +216,10 @@ class MicroBatchIngestor:
                 attempt, f"ingest:{batch}", policy=self.policy)
             if attempts > 1:
                 obs.inc("engine.ingest.retries", attempts - 1)
+            post = self._versions()
+            self._grow_dicts(pre, post)
             rec = {"event": "done", "batch": batch, "fn": name,
-                   "post_versions": self._versions(),
+                   "post_versions": post,
                    "attempts": attempts, "ts": round(time.time(), 3)}
             atomic.append_jsonl(self.journal_path, rec)
         return rec

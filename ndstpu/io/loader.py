@@ -28,7 +28,7 @@ import pyarrow.dataset as pads
 
 from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
-from ndstpu.io import lake
+from ndstpu.io import gdict, lake
 
 
 @dataclass
@@ -190,7 +190,11 @@ def load_catalog(warehouse: str, tables: Optional[List[str]] = None,
             order = [c.name for c in sch.columns
                      if c.name in at.column_names]
             at = at.select(order)
-        return columnar.from_arrow(at, sch)
+        # encode strings against the table's frozen global dictionary
+        # sidecar (if present), so resident codes match what chunk
+        # sources and other processes emit for the same warehouse
+        gds = gdict.table_dicts(os.path.join(warehouse, t), t)
+        return columnar.from_arrow(at, sch, gdicts=gds or None)
 
     if max_workers is None:
         max_workers = int(os.environ.get("NDSTPU_IO_WORKERS", "4"))
@@ -224,6 +228,40 @@ def load_catalog(warehouse: str, tables: Optional[List[str]] = None,
 class StreamUnsupported(RuntimeError):
     """A table/column shape the streaming scan cannot serve (the caller
     falls back to the resident path, never wedges)."""
+
+
+def _string_stream_reject(table: str, col: str) -> StreamUnsupported:
+    """Why a string column cannot stream, naming the knob that changes
+    the answer: streaming strings requires the table's frozen global
+    dictionary (ndstpu/io/gdict.py) so every chunk emits codes in one
+    shared code space."""
+    if not gdict.enabled():
+        why = ("global dictionaries are disabled "
+               "(NDSTPU_GLOBAL_DICTS=0)")
+    else:
+        why = (f"the table has no {gdict.GDICT_FILE} sidecar covering "
+               f"it — re-transcode the warehouse to build one; "
+               f"scripts/dict_audit.py (DICT_AUDIT.md) reports "
+               f"per-column coverage")
+    return StreamUnsupported(
+        f"string column {col} of {table}: per-chunk dictionaries do not "
+        f"share a code space, and {why}")
+
+
+def _check_gdict_decode(t: columnar.Table, table: str) -> columnar.Table:
+    """A decoded chunk must carry its strings in the frozen global code
+    space; local-dictionary fallback (a value missing from the sidecar)
+    would silently emit codes other chunks disagree with."""
+    for n, c in t.columns.items():
+        if c.ctype.kind == "string" and c.gdict is None:
+            raise StreamUnsupported(
+                f"string column {n} of {table}: chunk holds values "
+                f"outside the frozen global dictionary (stale "
+                f"{gdict.GDICT_FILE} sidecar — re-transcode the table "
+                f"or check DICT_AUDIT.md coverage; "
+                f"NDSTPU_GLOBAL_DICTS=0 disables string streaming "
+                f"entirely)")
+    return t
 
 
 #: one decoded chunk: column name -> (data, validity) numpy arrays,
@@ -284,11 +322,14 @@ class ParquetChunkSource(ChunkSource):
     warehouse table's parquet files, row-group-aligned, decoded with
     the same ``from_arrow`` rules the resident loader uses.
 
-    String columns are rejected (``StreamUnsupported``): per-chunk
-    dictionary encodings would not share a code space, and the traced
-    spine treats dictionaries as compile-time constants.  Hive
-    partition-key columns live in directory names, not the files, and
-    are likewise rejected.
+    String columns stream when the table carries a global dictionary
+    sidecar (ndstpu/io/gdict.py): every chunk decodes its strings
+    against the frozen table-wide dictionary, so codes agree with the
+    resident load and the traced spine's compile-time dictionary.
+    Without a sidecar (or with ``NDSTPU_GLOBAL_DICTS=0``) they are
+    rejected (``StreamUnsupported``): per-chunk dictionary encodings
+    would not share a code space.  Hive partition-key columns live in
+    directory names, not the files, and are likewise rejected.
     """
 
     def __init__(self, warehouse: str, table: str,
@@ -321,15 +362,15 @@ class ParquetChunkSource(ChunkSource):
                 f"columns {missing} not in {table} parquet files "
                 f"(hive partition keys cannot stream)")
         self._cols = self.columns = list(columns)
+        self._gdicts = gdict.table_dicts(root, table)
         if self._schema is not None:
             for c in self._cols:
                 try:
-                    if self._schema.column(c).dtype.kind == "string":
-                        raise StreamUnsupported(
-                            f"string column {c}: per-chunk dictionaries "
-                            f"do not share a code space")
+                    kind = self._schema.column(c).dtype.kind
                 except KeyError:
-                    pass
+                    continue
+                if kind == "string" and c not in self._gdicts:
+                    raise _string_stream_reject(table, c)
         # global row index: (path, row_group, global_start, n_rows)
         self._groups: List[tuple] = []
         total = 0
@@ -348,17 +389,20 @@ class ParquetChunkSource(ChunkSource):
             meta = {}
             for n in self._cols:
                 c = t.column(n)
-                if c.ctype.kind == "string":
-                    raise StreamUnsupported(
-                        f"string column {n} cannot stream")
-                meta[n] = (c.ctype, c.data.dtype, None)
+                if c.ctype.kind == "string" and n not in self._gdicts:
+                    raise _string_stream_reject(self.table, n)
+                meta[n] = (c.ctype, c.data.dtype,
+                           self._gdicts[n].values
+                           if c.ctype.kind == "string" else None)
             self._meta = meta
         return self._meta
 
     def _decode(self, path: str, group: int) -> columnar.Table:
         at = self._pq.ParquetFile(path).read_row_group(
             group, columns=self._cols)
-        return columnar.from_arrow(at.select(self._cols), self._schema)
+        t = columnar.from_arrow(at.select(self._cols), self._schema,
+                                gdicts=self._gdicts or None)
+        return _check_gdict_decode(t, self.table)
 
     def read(self, start: int, count: int) -> ChunkPayload:
         from ndstpu import faults, obs
@@ -408,9 +452,12 @@ class LakeChunkSource(ChunkSource):
     File-granular rather than row-group-granular: lake data files are
     micro-batch sized (one per refresh-function commit), so a read
     decodes each overlapping file, masks its deleted rows, and slices
-    the requested live-row window.  String columns are rejected like
-    ParquetChunkSource (per-chunk dictionaries would not share a code
-    space).
+    the requested live-row window.  String columns stream against the
+    global-dictionary sidecar version matching the PIN (gdict entries
+    are stamped with the lake version that introduced them), so a
+    pinned reader decodes with the dictionary its snapshot was
+    committed under even while ingest grows the dict; without sidecar
+    coverage they are rejected like ParquetChunkSource.
     """
 
     def __init__(self, table_dir: str, table: Optional[str] = None,
@@ -469,15 +516,16 @@ class LakeChunkSource(ChunkSource):
             raise StreamUnsupported(
                 f"columns {missing} not in {self.table} data files")
         self._cols = self.columns = list(columns)
+        self._gdicts = gdict.table_dicts(
+            table_dir, self.table, pin_table_version=self.version)
         if self._schema is not None:
             for c in self._cols:
                 try:
-                    if self._schema.column(c).dtype.kind == "string":
-                        raise StreamUnsupported(
-                            f"string column {c}: per-chunk dictionaries "
-                            f"do not share a code space")
+                    kind = self._schema.column(c).dtype.kind
                 except KeyError:
-                    pass
+                    continue
+                if kind == "string" and c not in self._gdicts:
+                    raise _string_stream_reject(self.table, c)
         self._meta: Optional[Dict[str, tuple]] = None
 
     def column_meta(self) -> Dict[str, tuple]:
@@ -490,17 +538,20 @@ class LakeChunkSource(ChunkSource):
             meta = {}
             for n in self._cols:
                 c = t.column(n)
-                if c.ctype.kind == "string":
-                    raise StreamUnsupported(
-                        f"string column {n} cannot stream")
-                meta[n] = (c.ctype, c.data.dtype, None)
+                if c.ctype.kind == "string" and n not in self._gdicts:
+                    raise _string_stream_reject(self.table, n)
+                meta[n] = (c.ctype, c.data.dtype,
+                           self._gdicts[n].values
+                           if c.ctype.kind == "string" else None)
             self._meta = meta
         return self._meta
 
     def _decode(self, path: str,
                 keep: Optional[np.ndarray]) -> columnar.Table:
         at = self._pq.read_table(path, columns=self._cols)
-        t = columnar.from_arrow(at.select(self._cols), self._schema)
+        t = columnar.from_arrow(at.select(self._cols), self._schema,
+                                gdicts=self._gdicts or None)
+        _check_gdict_decode(t, self.table)
         if keep is not None:
             t = t.filter(keep)
         return t
@@ -683,6 +734,25 @@ def attach_stream_source(catalog: Catalog, name: str,
         raise ValueError(
             f"stream source rows ({source.num_rows}) != resident rows "
             f"({catalog.get(name).num_rows}) for {name}")
+    # string chunks must decode into the RESIDENT code space: the traced
+    # spine bakes the resident dictionary in as a compile-time constant
+    resident = catalog.get(name)
+    if any(col in resident.columns
+           and resident.column(col).ctype.kind == "string"
+           for col in source.columns):
+        for col, (ct, _dt, d) in source.column_meta().items():
+            if ct.kind != "string" or col not in resident.columns:
+                continue
+            rd = resident.column(col).dictionary
+            if d is None or rd is None or not np.array_equal(
+                    np.asarray(d, dtype=object),
+                    np.asarray(rd, dtype=object)):
+                raise ValueError(
+                    f"stream source dictionary for {name}.{col} does "
+                    f"not match the resident dictionary — codes would "
+                    f"disagree across chunks (was the "
+                    f"{gdict.GDICT_FILE} sidecar rebuilt after the "
+                    f"catalog loaded?)")
     streams = getattr(catalog, "streams", None)
     if streams is None:       # catalogs unpickled from older snapshots
         streams = catalog.streams = {}

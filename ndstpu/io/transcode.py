@@ -139,8 +139,31 @@ def transcode_table(args, table: str, tschema) -> float:
     else:
         _write_single(at, out_root, table, args.output_format,
                       args.compression)
+    _build_global_dicts(args, table, out_root, at)
     atomic.atomic_write_text(marker, "")
     return time.time() - start
+
+
+def _build_global_dicts(args, table: str, out_root: str, at) -> None:
+    """Build/grow the table's global string-dictionary sidecar
+    (ndstpu/io/gdict.py) after the data write, before the _SUCCESS
+    marker — so a marked table always has a sidecar covering it.
+    Append mode unions with the existing sidecar (value set grows
+    append-only); ACID formats stamp entries with the commit version
+    so snapshot-pinned readers can select the dict matching their
+    pin."""
+    from ndstpu.io import gdict
+    if not gdict.enabled():
+        return
+    uniques = gdict.string_uniques_arrow(at)
+    if not uniques:
+        return
+    table_version = None
+    if args.output_format in ("ndslake", "ndsdelta"):
+        from ndstpu.io import lake
+        table_version = lake.current_version(out_root)
+    gdict.update_sidecar(out_root, table, uniques,
+                         table_version=table_version)
 
 
 def transcode(args) -> None:

@@ -7,7 +7,7 @@ suite via tests/test_doc_lint.py):
    STATUS.md) for cited artifact paths (``docs/*.json``/``docs/*.csv``
    and root ``BENCH_*.json`` / ``PLAN_LINT.json`` / ``PLAN_LINT.md`` /
    ``CANON_AUDIT.json`` / ``CANON_AUDIT.md`` / ``MQO_AUDIT.json`` /
-   ``MQO_AUDIT.md``)
+   ``MQO_AUDIT.md`` / ``DICT_AUDIT.json`` / ``DICT_AUDIT.md``)
    and fail when a cited file is absent
    from the tree.  A citation whose line carries an explicit
    not-here-yet marker (``pending``, ``uncommitted``,
@@ -42,6 +42,7 @@ CITED_RE = re.compile(
     r"|\bPLAN_LINT\.(?:json|md)\b"
     r"|\bCANON_AUDIT\.(?:json|md)\b"
     r"|\bMQO_AUDIT\.(?:json|md)\b"
+    r"|\bDICT_AUDIT\.(?:json|md)\b"
     r"|\bRUN_STATE\.json\b"
     r"|\bINGEST_DIFF\.json\b")
 

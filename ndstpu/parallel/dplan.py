@@ -1017,10 +1017,16 @@ class DistributedPlanExecutor:
                     # string keys join in the BUILD dictionary's code
                     # space; the traced probe translates its own codes
                     # through a static mapping (both dictionaries are
-                    # host metadata at trace time)
+                    # host metadata at trace time) — or uses them
+                    # directly when both sides carry the same frozen
+                    # global dictionary (_probe_keys identity path)
                     if c.dictionary is None:
                         raise DistUnsupported(
-                            "string join key without dictionary",
+                            "string join key without dictionary (no "
+                            "frozen global dict either — see "
+                            "DICT_AUDIT.md coverage; "
+                            "NDSTPU_GLOBAL_DICTS=0 disables the "
+                            "global-dictionary path)",
                             code="NDS307")
                     key_parts.append(c.data.astype(np.int64))
                     key_dicts.append(c.dictionary)
@@ -1471,14 +1477,23 @@ class DistributedPlanExecutor:
         scanned row width, not a hand-tuned constant."""
         if self.chunk_rows == "auto":
             from ndstpu.engine import memplan
+            from ndstpu.io import gdict
             bpr = memplan.row_bytes(
                 [fact_table.column(nm).data.dtype.itemsize
                  for nm in names])
+            # string codes stream per chunk, but their frozen
+            # dictionaries ride every device whole-query — carve their
+            # bytes out of the budget before sizing chunks
+            dict_bytes = sum(
+                gdict.dictionary_nbytes(fact_table.column(nm).dictionary)
+                for nm in names
+                if fact_table.column(nm).ctype.kind == "string")
             max_depth = self.prefetch_depth \
                 if self.prefetch_depth is not None \
                 else memplan.DEFAULT_MAX_DEPTH
             plan = memplan.plan_stream(n, bpr, self.n_dev,
-                                       max_depth=max_depth)
+                                       max_depth=max_depth,
+                                       dict_bytes=dict_bytes)
             obs.annotate(stream_plan=plan.describe())
             obs.set_gauge("engine.stream.chunk_rows",
                           plan.chunk_rows or 0)
@@ -1727,22 +1742,39 @@ class DistributedPlanExecutor:
             c = evl.eval(e)
             if kd is not None:
                 if c.ctype.kind != "string" or c.dictionary is None:
-                    raise DistUnsupported("string key against "
-                                          f"{c.ctype.kind} probe",
-                                          code="NDS307")
+                    raise DistUnsupported(
+                        f"string key against {c.ctype.kind} probe "
+                        f"(no shared global dictionary — see "
+                        f"DICT_AUDIT.md; NDSTPU_GLOBAL_DICTS=0 "
+                        f"disables the global-dictionary path)",
+                        code="NDS307")
                 np_dict = c.dictionary
-                if len(np_dict) and len(kd):
+                from ndstpu.io import gdict as _gdict
+                if _gdict.enabled() and len(kd) == len(np_dict) and \
+                        np.array_equal(kd, np_dict):
+                    # both sides carry the same frozen code space
+                    # (warehouse-wide global dictionary): codes ARE the
+                    # key parts, no translation table.  Negative codes
+                    # (NULL -1 / translate-miss -2) map out-of-domain.
+                    obs.inc("engine.dict.identity_joins")
+                    part = jnp.where(
+                        c.data >= 0, c.data.astype(jnp.int64),
+                        jnp.int64(len(kd)))
+                elif len(np_dict) and len(kd):
                     pos = np.searchsorted(kd, np_dict)
                     posc = np.clip(pos, 0, len(kd) - 1)
                     ok = kd[posc] == np_dict
                     mapping = np.where(ok, posc,
                                        np.int64(len(kd))).astype(np.int64)
+                    codes = jnp.clip(c.data.astype(jnp.int64), 0,
+                                     max(len(np_dict) - 1, 0))
+                    part = jnp.asarray(mapping)[codes]
                 else:
                     mapping = np.full(max(len(np_dict), 1), len(kd),
                                       np.int64)
-                codes = jnp.clip(c.data.astype(jnp.int64), 0,
-                                 max(len(np_dict) - 1, 0))
-                part = jnp.asarray(mapping)[codes]
+                    codes = jnp.clip(c.data.astype(jnp.int64), 0,
+                                     max(len(np_dict) - 1, 0))
+                    part = jnp.asarray(mapping)[codes]
             elif c.ctype.kind not in _KEY_KINDS:
                 raise DistUnsupported(f"{c.ctype.kind} probe key",
                                       code="NDS307")

@@ -2,7 +2,8 @@
 
 Fails (exit 1) when committed prose cites an artifact that is not in
 the tree (including the root ``PLAN_LINT.*`` / ``CANON_AUDIT.*`` /
-``MQO_AUDIT.*`` sweeps), or when a ``docs/*.json`` artifact pins
+``MQO_AUDIT.*`` / ``DICT_AUDIT.*`` sweeps), or when a ``docs/*.json``
+artifact pins
 ``engine_defaults``
 that no longer match the engine source and is not stamped stale.
 

@@ -584,3 +584,102 @@ def test_ingestor_run_skips_journaled_done(tmp_path):
     ing2.run([("b0", mk("b0")), ("b1", mk("b1"))], resume=True)
     assert applied == ["b0", "b1"]
     assert lake.versions_vector(wh) == {"alpha": 2}
+
+
+def test_ingest_grows_global_dicts_pinned_reads_survive(tmp_path):
+    """A refresh batch carrying never-seen strings grows the global
+    dictionary append-only: new loads see the grown value set, pinned
+    snapshot readers keep decoding with the dict matching their pin,
+    and the warehouse epoch moves so epoch-keyed caches drop stale
+    entries (engine.snapshot.stale_drops)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from ndstpu import obs
+    from ndstpu.engine import columnar
+    from ndstpu.engine import spine as rt_spine
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import gdict, lake
+    from ndstpu.io.loader import LakeChunkSource
+
+    wh = str(tmp_path / "wh")
+    root = os.path.join(wh, "alpha")
+    lake.create_table("ndslake", root, pa.table(
+        {"s": pa.array(["birch", "ash", "birch"])}))
+    gdict.grow_for_table(root, "alpha")
+    pin0 = lake.current_version(root)
+    d0 = gdict.table_dicts(root, "alpha")["s"]
+    assert list(d0.values) == ["ash", "birch"]
+
+    cache = rt_spine.SpineCache(64 << 20)
+    state0 = (lake.warehouse_epoch(wh), ())
+    cache.put("vk", state0, columnar.Table(
+        {"v": columnar.Column.from_numpy(
+            np.arange(4, dtype=np.int64), columnar.INT64)}))
+
+    ing = MicroBatchIngestor(wh)
+    ing.apply_batch("b0", lambda: lake.append(
+        root, pa.table({"s": pa.array(["cedar", "ash"])})))
+
+    # new loads see the grown, re-sorted dict (a NEW frozen version)
+    d1 = gdict.table_dicts(root, "alpha")["s"]
+    assert list(d1.values) == ["ash", "birch", "cedar"]
+    assert d1.version == d0.version + 1
+    # pinned snapshots keep decoding against their matching version
+    dp = gdict.table_dicts(root, "alpha", pin_table_version=pin0)["s"]
+    assert list(dp.values) == list(d0.values)
+    src = LakeChunkSource(root, "alpha", version=pin0)
+    codes, valid = src.read(0, src.num_rows)["s"]
+    assert valid.all()
+    assert [str(dp.values[c]) for c in codes] == \
+        ["birch", "ash", "birch"]
+
+    # dict growth rides the snapshot epoch: the pre-ingest cache entry
+    # is dropped, not served
+    state1 = (lake.warehouse_epoch(wh), ())
+    assert state1 != state0
+    before = obs.counters_snapshot()
+    assert cache.get("vk", state1) is None
+    assert obs.counter_delta(before).get(
+        "engine.snapshot.stale_drops", 0) >= 1
+
+
+def test_ingest_crash_retracts_dict_versions(tmp_path):
+    """A crash after the dict grew but before the batch journaled done
+    retracts the dict versions with the lake commits: resume() leaves
+    the sidecar on the clean-run trajectory, so a re-applied batch
+    regrows identically."""
+    import pyarrow as pa
+
+    from ndstpu.harness.ingest import MicroBatchIngestor
+    from ndstpu.io import gdict, lake
+
+    wh = str(tmp_path / "wh")
+    root = os.path.join(wh, "alpha")
+    lake.create_table("ndslake", root, pa.table(
+        {"s": pa.array(["birch", "ash"])}))
+    gdict.grow_for_table(root, "alpha")
+    ing = MicroBatchIngestor(wh)
+
+    class Crash(RuntimeError):
+        pass
+
+    def partial():
+        lake.append(root, pa.table({"s": pa.array(["dogwood"])}))
+        gdict.grow_for_table(root, "alpha")  # grew, then died pre-done
+        raise Crash("died mid-batch")
+
+    with pytest.raises(Crash):
+        ing.apply_batch("b0", partial)
+    assert "dogwood" in list(gdict.table_dicts(root, "alpha")["s"].values)
+
+    assert ing.resume() == "b0"
+    d = gdict.table_dicts(root, "alpha")["s"]
+    assert list(d.values) == ["ash", "birch"]
+
+    # the re-applied batch converges: same rows, same dict versions
+    ing.apply_batch("b0", lambda: lake.append(
+        root, pa.table({"s": pa.array(["dogwood"])})))
+    d2 = gdict.table_dicts(root, "alpha")["s"]
+    assert list(d2.values) == ["ash", "birch", "dogwood"]
+    assert d2.table_version == lake.current_version(root)

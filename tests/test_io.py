@@ -480,3 +480,93 @@ def test_lake_chunk_source_windows_and_deletes(tmp_path, fmt):
     assert fresh.num_rows == 8
     vals, _ = fresh.read(0, 8)["k"]
     assert sorted(vals.tolist()) == [0, 2, 3, 4, 5, 6, 7, 99]
+
+
+# ---- global dictionary sidecars (io/gdict.py) ------------------------------
+
+
+def test_transcode_builds_gdict_sidecars(warehouse):
+    """Transcode writes a _GLOBAL_DICTS.json sidecar per string-bearing
+    table; the loader encodes resident columns against it, so resident
+    codes ARE the warehouse-wide code space."""
+    from ndstpu.io import gdict
+    assert gdict.has_sidecar(str(warehouse / "item"))
+    gds = gdict.table_dicts(str(warehouse / "item"), "item")
+    cat = loader.load_catalog(str(warehouse), ["item"])
+    c = cat.get("item").column("i_category")
+    assert c.gdict is not None
+    assert list(c.dictionary) == list(gds["i_category"].values)
+    d = gds["i_category"]
+    assert list(d.values) == sorted(d.values)
+    assert d.hash == gdict.content_hash(d.values)
+    assert d.nbytes == sum(len(str(v).encode()) for v in d.values)
+
+
+def test_gdict_kill_switch_disables_layer(warehouse, monkeypatch):
+    from ndstpu.io import gdict
+    monkeypatch.setenv("NDSTPU_GLOBAL_DICTS", "0")
+    assert not gdict.enabled()
+    assert gdict.table_dicts(str(warehouse / "item"), "item") == {}
+    cat = loader.load_catalog(str(warehouse), ["item"])
+    assert cat.get("item").column("i_category").gdict is None
+
+
+def test_gdict_update_sidecar_append_only(tmp_path):
+    """Growth produces a NEW sorted version; the value set only grows;
+    re-running with the same values writes nothing new; pinned
+    selection returns the version matching the pin."""
+    import numpy as np
+
+    from ndstpu.io import gdict
+    td = str(tmp_path / "t")
+    gdict.update_sidecar(td, "t", {"s": np.asarray(
+        ["birch", "ash"], object)}, table_version=0)
+    d0 = gdict.table_dicts(td, "t")["s"]
+    assert list(d0.values) == ["ash", "birch"] and d0.version == 0
+
+    # idempotent: same value set -> no new version
+    gdict.update_sidecar(td, "t", {"s": np.asarray(
+        ["ash", "birch"], object)}, table_version=1)
+    assert gdict.table_dicts(td, "t")["s"].version == 0
+
+    # growth: union, re-sorted, new version stamped with the commit
+    gdict.update_sidecar(td, "t", {"s": np.asarray(
+        ["cedar", "ash"], object)}, table_version=2)
+    d2 = gdict.table_dicts(td, "t")["s"]
+    assert list(d2.values) == ["ash", "birch", "cedar"]
+    assert d2.version == 1 and d2.table_version == 2
+    # snapshot-pinned readers keep their matching version
+    dp = gdict.table_dicts(td, "t", pin_table_version=1)["s"]
+    assert list(dp.values) == ["ash", "birch"] and dp.version == 0
+
+
+def test_parquet_chunk_source_streams_strings(warehouse):
+    """String tables stream chunk-wise: every chunk decodes against the
+    frozen sidecar dictionary, so chunk codes agree with the resident
+    load (the invariant that unlocked out-of-core string tables)."""
+    import numpy as np
+
+    cat = loader.load_catalog(str(warehouse), ["item"])
+    resident = cat.get("item")
+    src = loader.ParquetChunkSource(
+        str(warehouse), "item", ["i_item_sk", "i_category"])
+    assert src.num_rows == resident.num_rows
+    meta = src.column_meta()
+    assert list(meta["i_category"][2]) == \
+        list(resident.column("i_category").dictionary)
+    codes = []
+    for start in range(0, src.num_rows, 7):
+        vals, _ = src.read(start, min(7, src.num_rows - start))[
+            "i_category"]
+        codes.extend(vals.tolist())
+    assert np.array_equal(
+        np.asarray(codes), resident.column("i_category").data)
+
+
+def test_parquet_chunk_source_rejects_strings_without_dicts(
+        warehouse, monkeypatch):
+    monkeypatch.setenv("NDSTPU_GLOBAL_DICTS", "0")
+    with pytest.raises(loader.StreamUnsupported) as ei:
+        loader.ParquetChunkSource(str(warehouse), "item",
+                                  ["i_item_sk", "i_category"])
+    assert "NDSTPU_GLOBAL_DICTS" in str(ei.value)
