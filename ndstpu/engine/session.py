@@ -714,6 +714,15 @@ class Session:
                 f"{self._views_epoch}|{normalize_sql_key(text)}")
         return cp
 
+    def compiled_count(self) -> int:
+        """Number of whole-query compile records this session holds.
+        The serve layer polls this after each request to persist compile
+        records incrementally — a SIGKILL'd server must still warm-start
+        from everything compiled before the kill, so it cannot wait for
+        a clean drain to call :meth:`save_compiled`."""
+        exe = getattr(self, "_jax_exec_cache", None)
+        return len(exe._compiled) if exe is not None else 0
+
     def save_compiled(self, path: str) -> int:
         """Persist whole-query size-plan records for the jax backend."""
         return self._jax_executor().save_compile_records(path)

@@ -17,6 +17,9 @@ site                   probe location
 ``phase.subprocess``   bench driver phase subprocess launch
 ``ingest.commit``      lake CAS commit publish (io/acid, io/deltalog)
 ``ingest.apply``       micro-batch ingest apply (harness/ingest)
+``serve.accept``       query-server connection accept loop (serve/server)
+``serve.dispatch``     query-server request dispatch, pre-retry — faults
+                       here are client-visible and exercise client retry
 =====================  ====================================================
 
 A spec is a comma-separated rule list::
@@ -49,7 +52,8 @@ from ndstpu import obs
 
 SITES = ("plan", "compile", "execute", "io.write", "io.read",
          "io.prefetch", "exchange.collective", "stream.worker",
-         "phase.subprocess", "ingest.commit", "ingest.apply")
+         "phase.subprocess", "ingest.commit", "ingest.apply",
+         "serve.accept", "serve.dispatch")
 
 KINDS = ("transient", "permanent", "hang")
 

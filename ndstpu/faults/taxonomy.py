@@ -35,8 +35,13 @@ TRANSIENT_TYPE_NAMES = frozenset({
     "ConnectionError",
     "ConnectionResetError",
     "ConnectionAbortedError",
+    "ConnectionRefusedError",
     "BrokenPipeError",
     "InterruptedError",
+    # socket.timeout: an alias of TimeoutError since 3.10, but the
+    # class *name* along the MRO is "timeout" on older pickles/paths —
+    # a dropped serve connection must never classify permanent
+    "timeout",
 })
 
 # class names that are definitely not retry-worthy, checked FIRST so a
@@ -65,6 +70,9 @@ TRANSIENT_MESSAGE_KEYWORDS = (
     "unavailable",
     "connection reset",
     "connection closed",
+    "connection refused",
+    "connection aborted",
+    "broken pipe",
     "socket closed",
     "preempt",
     "temporarily",

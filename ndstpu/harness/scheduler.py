@@ -131,7 +131,13 @@ class StreamScheduler:
         # every compile per stream.
         from ndstpu.engine.sql import normalize_sql_key
         kf = key_fn or normalize_sql_key
+        self._kf = kf
         self._lock = threading.RLock()
+        # continuous-feed mode (serve layer): open streams may gain
+        # work after construction; _next blocks on this condition when
+        # an open stream's queue is momentarily empty
+        self._cv = threading.Condition(self._lock)
+        self._open: set = set()
         self.budget_s = budget_s if budget_s and budget_s > 0 else None
         self.phase = phase
         self.default_cost_s = default_cost_s
@@ -149,6 +155,43 @@ class StreamScheduler:
 
     def view(self, sid: str) -> _StreamView:
         return self._views[sid]
+
+    # -- continuous-feed mode (serve layer) ---------------------------------
+    #
+    # The batch harness builds the scheduler from fixed per-stream work
+    # lists.  The query server instead OPENS a stream per connection,
+    # FEEDS it one request at a time, and CLOSES it when the client
+    # hangs up; a view whose queue is momentarily empty but still open
+    # blocks in next() instead of reporting done.  Cross-stream compile
+    # dedup (compiled/inflight keyed by canonical key) works unchanged,
+    # so concurrent connections sending the same plan shape share one
+    # compile exactly like batch streams do.
+
+    def open_stream(self, sid: str) -> _StreamView:
+        """Create (or reopen) a continuously-fed stream."""
+        with self._lock:
+            if sid not in self._views:
+                self._views[sid] = _StreamView(self, sid, [])
+            self._open.add(sid)
+            return self._views[sid]
+
+    def feed(self, sid: str, name: str, sql: str) -> None:
+        """Append one work item to an open stream; wakes its next()."""
+        with self._lock:
+            if sid not in self._open:
+                raise ValueError(f"stream {sid!r} is not open for feed")
+            view = self._views[sid]
+            self._key[(sid, name)] = self._kf(sql)
+            view._order[name] = len(view._order)
+            view._names.append(name)
+            self._cv.notify_all()
+
+    def close(self, sid: str) -> None:
+        """Stop feeding a stream: pending items still drain, then its
+        next() returns None instead of blocking."""
+        with self._lock:
+            self._open.discard(sid)
+            self._cv.notify_all()
 
     # -- internals (called by the views) -------------------------------------
 
@@ -169,6 +212,10 @@ class StreamScheduler:
 
     def _next(self, view: _StreamView, elapsed_s: float) -> Optional[str]:
         with self._lock:
+            # continuous-feed: an open-but-empty stream waits for work
+            # (or for close()); batch streams never enter the wait
+            while not view._names and view.sid in self._open:
+                self._cv.wait(timeout=0.5)
             if not view._names:
                 return None
             if self.budget_s is not None:

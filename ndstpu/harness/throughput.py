@@ -16,6 +16,12 @@ The reference fans out concurrent spark-submit processes with
   ndstpu.harness.scheduler.  Same `--concurrent` slot semantics
   (in-process gate), same overlap-report format, same time-log
   contract.
+* ``--mode serve --serve_socket PATH``: the streams become N client
+  connections to a RUNNING query server (ndstpu/serve) — the spec's
+  throughput phase doubling as a server load test.  Admission slots,
+  tenant budgets, and shedding are the server's; each stream runs as
+  its own tenant and the shared overlap-report format records what the
+  server let overlap.
 
     python -m ndstpu.harness.throughput 1,2,3 --concurrent 2 -- \\
         python -m ndstpu.harness.power ./query_{}.sql ./wh ./time_{}.csv
@@ -216,6 +222,112 @@ def run_throughput(stream_ids: List[str], cmd_template: List[str],
             shutil.rmtree(lock_dir, ignore_errors=True)
 
 
+def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
+                      serve_socket: str,
+                      budget_s: Optional[float] = None,
+                      overlap_report: Optional[str] = None) -> int:
+    """Route the throughput phase through a running query server.
+
+    ``cmd_template`` is the same ``{}``-placeholder power command the
+    other modes take — parsed per stream with the power CLI's parser so
+    all three modes share one argument contract — but here only the
+    stream files/subsets matter: execution, admission, and output
+    writing happen inside the server.  Each stream is one client
+    connection (= one server-side scheduler stream) under its own
+    tenant; queries go up serially per stream like a power run, and the
+    server decides what overlaps."""
+    import threading
+
+    from ndstpu.harness import power, scheduler
+    from ndstpu.serve.client import ServeClient
+
+    tail = scheduler._power_tail(cmd_template)
+    parser = power.build_parser()
+    t0 = time.time()
+    records: List[dict] = []
+    rec_lock = threading.Lock()
+    health = {}
+
+    def worker(sid: str) -> None:
+        ns = parser.parse_args([a.replace("{}", sid) for a in tail])
+        qd = power.gen_sql_from_stream(ns.query_stream_file)
+        if ns.sub_queries:
+            qd = power.get_query_subset(qd, ns.sub_queries.split(","))
+        stem = os.path.splitext(
+            os.path.basename(ns.query_stream_file))[0]
+        cli = ServeClient(serve_socket, tenant=f"stream-{sid}")
+        start = time.time()
+        code = executed = failures = skipped = 0
+        obs.inc("harness.throughput.streams_launched")
+        try:
+            if not cli.wait_ready(60.0):
+                raise ConnectionError(
+                    f"server at {serve_socket} not ready")
+            for qname, sql in qd.items():
+                elapsed = time.time() - start
+                if budget_s and elapsed >= budget_s:
+                    skipped = len(qd) - executed - failures
+                    print(f"[serve-stream {sid}] budget exhausted "
+                          f"({elapsed:.1f}s >= {budget_s:g}s): "
+                          f"skipping {skipped} queries")
+                    break
+                deadline = (budget_s - elapsed) if budget_s else None
+                try:
+                    cli.sql(sql, name=f"{stem}/{qname}"
+                            if ns.output_prefix else None,
+                            deadline_s=deadline)
+                    executed += 1
+                except Exception as e:  # noqa: BLE001 — per-query
+                    failures += 1
+                    print(f"[serve-stream {sid}] {qname} failed: "
+                          f"{type(e).__name__}: {e}")
+            code = 1 if failures else 0
+        except Exception as e:  # noqa: BLE001 — stream-fatal
+            print(f"[serve-stream {sid}] failed: "
+                  f"{type(e).__name__}: {e}")
+            obs.inc("harness.throughput.streams_failed")
+            code = 1
+        finally:
+            try:
+                health.update(cli.health())
+            except Exception:  # noqa: BLE001 — evidence only
+                pass
+            cli.close()
+        end = time.time()
+        with rec_lock:
+            records.append({
+                "stream": sid,
+                "start_epoch_s": round(start, 3),
+                "end_epoch_s": round(end, 3),
+                "wall_s": round(end - start, 3),
+                "returncode": code,
+                "executed": executed,
+                "failures": failures,
+                "skipped": skipped,
+                "client_retries": cli.retried,
+            })
+
+    threads = [threading.Thread(target=worker, args=(sid,),
+                                name=f"serve-stream-{sid}",
+                                daemon=True)
+               for sid in stream_ids]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    rc = 1 if any(r["returncode"] for r in records) else 0
+    # overlap evidence: stream walls from the client side; the device-
+    # level peak is whatever the server's admission gate enforced,
+    # reported via its health doc
+    write_overlap_report(
+        overlap_report, records, health.get("admitted_peak"),
+        budget_s, mode="serve",
+        extra={"serve_socket": serve_socket,
+               "server_health": health or None,
+               "total_elapse_s": round(time.time() - t0, 3)})
+    return rc
+
+
 def main(argv: List[str]) -> int:
     # wrapper flags are parsed only from the part BEFORE the "--"
     # separator so the wrapped command's own flags are safe
@@ -250,9 +362,17 @@ def main(argv: List[str]) -> int:
         print(err, file=sys.stderr)
         return 2
     mode, err = take("--mode", str,
-                     lambda v: v in ("process", "inproc"))
+                     lambda v: v in ("process", "inproc", "serve"))
     if err:
         print(err, file=sys.stderr)
+        return 2
+    serve_socket, err = take("--serve_socket", str)
+    if err:
+        print(err, file=sys.stderr)
+        return 2
+    if mode == "serve" and not serve_socket:
+        print("--mode serve requires --serve_socket PATH "
+              "(a running ndstpu-serve server)", file=sys.stderr)
         return 2
     if budget_s is None and os.environ.get("NDSTPU_PHASE_BUDGET_S"):
         try:
@@ -266,10 +386,15 @@ def main(argv: List[str]) -> int:
     if not ids_arg or not cmd:
         print("usage: throughput <id,id,...> [--concurrent N] "
               "[--budget_s S] [--overlap_report PATH] "
-              "[--mode process|inproc] -- "
+              "[--mode process|inproc|serve] "
+              "[--serve_socket PATH] -- "
               "<command with {} placeholders>", file=sys.stderr)
         return 2
     stream_ids = [s for s in ids_arg[0].split(",") if s]
+    if mode == "serve":
+        return run_streams_serve(
+            stream_ids, cmd, serve_socket, budget_s=budget_s,
+            overlap_report=overlap_report)
     if mode == "inproc":
         from ndstpu.harness import scheduler
         return scheduler.run_streams_inproc(

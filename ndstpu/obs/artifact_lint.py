@@ -44,15 +44,16 @@ CITED_RE = re.compile(
     r"|\bMQO_AUDIT\.(?:json|md)\b"
     r"|\bDICT_AUDIT\.(?:json|md)\b"
     r"|\bRUN_STATE\.json\b"
-    r"|\bINGEST_DIFF\.json\b")
+    r"|\bINGEST_DIFF\.json\b"
+    r"|\bSLO\.json\b")
 
 EXEMPT_MARKERS = ("pending", "uncommitted", "not committed")
 
 # recognized per-run journals/artifacts: docs cite these by name (they
-# define the resume/differential contracts, docs/ROBUSTNESS.md) but
-# every run writes its own next to its artifacts — there is never a
-# committed copy to point at
-RUNTIME_ARTIFACTS = ("RUN_STATE.json", "INGEST_DIFF.json")
+# define the resume/differential/SLO contracts, docs/ROBUSTNESS.md and
+# docs/OBSERVABILITY.md) but every run writes its own next to its
+# artifacts — there is never a committed copy to point at
+RUNTIME_ARTIFACTS = ("RUN_STATE.json", "INGEST_DIFF.json", "SLO.json")
 
 _GROUPBY_DEFAULT_RE = re.compile(
     r'^GROUPBY_DEFAULT\s*=\s*["\'](\w+)["\']', re.MULTILINE)

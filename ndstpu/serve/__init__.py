@@ -1,0 +1,31 @@
+"""Always-on query service: the serve layer over one shared Session.
+
+The batch harness (power/throughput) runs fixed work lists and exits;
+``ndstpu.serve`` keeps the engine resident and puts a fault-tolerant
+front door on it:
+
+* :mod:`ndstpu.serve.protocol` — length-prefixed JSON request framing
+  shared by server and client;
+* :mod:`ndstpu.serve.overload` — admission control: bounded queue,
+  per-tenant token budgets, deadline-aware shedding, and a per-plan-
+  shape circuit breaker over the PR 5 quarantine list;
+* :mod:`ndstpu.serve.lifecycle` — the robustness control plane:
+  append-only serve journal, SIGTERM graceful drain, crash-safe warm
+  restart, and per-tenant latency SLO export (``SLO.json``);
+* :mod:`ndstpu.serve.server` — the socket front door feeding the
+  continuous-feed :class:`~ndstpu.harness.scheduler.StreamScheduler`
+  and :class:`~ndstpu.harness.admission.InprocAdmission`;
+* :mod:`ndstpu.serve.client` — reconnect-and-retry client.
+
+Entry point: ``ndstpu-serve`` (ndstpu/harness/serve.py).  Gated by
+``scripts/serve_smoke.py`` in CI (docs/ROBUSTNESS.md "Serving
+lifecycle").
+"""
+
+from ndstpu.serve.overload import (  # noqa: F401
+    AdmissionQueue,
+    CircuitBreaker,
+    Overloaded,
+    Rejected,
+    TenantBudgets,
+)

@@ -1,0 +1,191 @@
+"""Reconnect-and-retry client for the query server.
+
+The retry policy mirrors the server's typed shedding contract
+(serve/protocol.py):
+
+* **connection faults** (refused / reset / broken pipe / injected
+  ``serve.accept`` drops) — reconnect with deterministic backoff; all
+  classified transient by ndstpu/faults/taxonomy.py;
+* **``overloaded``** — sleep the server's ``retry_after_s`` hint, then
+  resend;
+* **``error`` with ``taxonomy: transient``** (injected
+  ``serve.dispatch`` faults, watchdog abandonment) — resend;
+* **``rejected``** / **``error`` permanent** — raise immediately:
+  the server said retrying unchanged cannot help;
+* **``draining``** — raise :class:`ServerDraining` (transient kind):
+  callers that know a restart is coming (chaos scenario H) keep
+  retrying until the new incarnation answers.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Optional
+
+from ndstpu.serve import protocol
+from ndstpu.serve.overload import Rejected
+
+
+class ServeError(RuntimeError):
+    """A permanent server-side failure, taxonomy attached."""
+
+    def __init__(self, message: str, taxonomy: str = "permanent",
+                 response: Optional[dict] = None):
+        super().__init__(message)
+        self.taxonomy = taxonomy
+        self.kind = taxonomy  # faults.taxonomy.classify reads .kind
+        self.response = response or {}
+
+
+class ServerDraining(RuntimeError):
+    kind = "transient"
+
+
+class ServeClient:
+    """One logical client; transparently reconnects across retries."""
+
+    def __init__(self, socket_path: str, tenant: str = "default",
+                 retries: int = 8, backoff_s: float = 0.05,
+                 max_backoff_s: float = 2.0,
+                 connect_timeout_s: float = 30.0):
+        self.socket_path = socket_path
+        self.tenant = tenant
+        self.retries = retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.connect_timeout_s = connect_timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self.retried = 0  # observable: how often retry paths fired
+
+    # -- transport -----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        deadline = time.monotonic() + self.connect_timeout_s
+        wait = self.backoff_s
+        while True:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                s.connect(self.socket_path)
+                self._sock = s
+                return s
+            except OSError:
+                s.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(wait)
+                wait = min(wait * 2, self.max_backoff_s)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _drop(self) -> None:
+        self.close()
+
+    def _roundtrip(self, msg: dict) -> dict:
+        sock = self._connect()
+        protocol.send_msg(sock, msg)
+        resp = protocol.recv_msg(sock)
+        if resp is None:
+            raise ConnectionResetError("server closed the connection")
+        return resp
+
+    # -- request with the typed retry contract -------------------------------
+
+    def request(self, msg: dict) -> dict:
+        attempt = 0
+        wait = self.backoff_s
+        while True:
+            attempt += 1
+            try:
+                resp = self._roundtrip(msg)
+            except (OSError, protocol.ProtocolError):
+                self._drop()
+                if attempt > self.retries:
+                    raise
+                self.retried += 1
+                time.sleep(wait)
+                wait = min(wait * 2, self.max_backoff_s)
+                continue
+            status = resp.get("status")
+            if status == "ok":
+                return resp
+            if status == "overloaded":
+                if attempt > self.retries:
+                    raise ServeError(
+                        f"still overloaded after {attempt} attempts: "
+                        f"{resp.get('error')}", taxonomy="transient",
+                        response=resp)
+                self.retried += 1
+                time.sleep(float(resp.get("retry_after_s") or wait))
+                continue
+            if status == "draining":
+                raise ServerDraining(
+                    resp.get("error") or "server is draining")
+            if status == "rejected":
+                raise Rejected(resp.get("error") or "rejected",
+                               reason=resp.get("reason") or "rejected")
+            # status == "error": retry transient, raise permanent
+            taxonomy = resp.get("taxonomy") or "permanent"
+            if taxonomy == "transient" and attempt <= self.retries:
+                self.retried += 1
+                time.sleep(wait)
+                wait = min(wait * 2, self.max_backoff_s)
+                continue
+            raise ServeError(
+                f"{resp.get('type', 'Error')}: {resp.get('error')}",
+                taxonomy=taxonomy, response=resp)
+
+    # -- ops -----------------------------------------------------------------
+
+    def _rid(self) -> str:
+        self._seq += 1
+        return f"{self.tenant}-{self._seq}"
+
+    def sql(self, sql: str, name: Optional[str] = None,
+            deadline_s: Optional[float] = None,
+            tenant: Optional[str] = None,
+            max_rows: int = 100) -> dict:
+        msg = {"op": "sql", "id": self._rid(), "sql": sql,
+               "tenant": tenant or self.tenant, "max_rows": max_rows}
+        if name is not None:
+            msg["name"] = name
+        if deadline_s is not None:
+            msg["deadline_s"] = deadline_s
+        return self.request(msg)
+
+    def ping(self) -> dict:
+        return self.request({"op": "ping", "id": self._rid()})
+
+    def health(self) -> dict:
+        return self.request(
+            {"op": "health", "id": self._rid()})["health"]
+
+    def stats(self) -> dict:
+        return self.request({"op": "stats", "id": self._rid()})
+
+    def drain(self) -> dict:
+        return self.request({"op": "drain", "id": self._rid()})
+
+    def wait_ready(self, timeout_s: float = 120.0,
+                   poll_s: float = 0.1) -> bool:
+        """Poll readiness (warm restart flips it only after replay)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                resp = self._roundtrip(
+                    {"op": "ready", "id": self._rid()})
+                if resp.get("ready"):
+                    return True
+            except (OSError, protocol.ProtocolError):
+                self._drop()
+            time.sleep(poll_s)
+        return False

@@ -30,12 +30,22 @@ an ``execute`` *permanent* fault and asserts it surfaces classified —
 ``faultTaxonomy.counts.permanent`` in the sidecar and a
 ``failed-permanent`` sentinel verdict — never as a silent skip.
 
-A final scenario SIGKILLs ``ndstpu.harness.ingest`` mid-run over a
-tiny synthetic lake warehouse and resumes it: the intent/done journal
-plus crash retraction (io lake ``abort_to_version``) must land the
-resumed run on snapshot versions and contents identical to an
-uninterrupted control (the full interleaved-vs-quiesced differential
-is scripts/ingest_smoke.py's job).
+Two SIGKILL epilogues follow the bench scenarios:
+
+G. **Kill mid-ingest** — SIGKILL ``ndstpu.harness.ingest`` mid-run
+   over a tiny synthetic lake warehouse and resume it: the intent/done
+   journal plus crash retraction (io lake ``abort_to_version``) must
+   land the resumed run on snapshot versions and contents identical to
+   an uninterrupted control (the full interleaved-vs-quiesced
+   differential is scripts/ingest_smoke.py's job).
+H. **Kill the query server mid-flight** — a ``ndstpu.harness.serve``
+   server is SIGKILLed while a client's query is wedged in an injected
+   ``execute`` hang; a healthy incarnation is started on the same
+   socket + state dir and the client's reconnect-and-retry loop must
+   converge, unattended, to results identical to an uninterrupted
+   control server (the compile-cache warm-restart proof lives in
+   scripts/serve_smoke.py leg 4 — this scenario gates the client-side
+   crash contract).
 """
 from __future__ import annotations
 
@@ -355,7 +365,88 @@ def main() -> int:
     print("ingest SIGKILL scenario OK: resumed to control-identical "
           "snapshot versions and contents")
 
-    print("chaos smoke OK: crash + 3 SIGKILLs resumed to "
+    # ---- H. SIGKILL the query server mid-flight; client recovers ----
+    import threading
+
+    from ndstpu.harness import power
+    from ndstpu.serve.client import ServeClient
+
+    def start_serve(sock, state_dir, log_path, env=None):
+        cmd = [sys.executable, "-m", "ndstpu.harness.serve", "server",
+               "--socket", str(sock),
+               "--input_prefix", str(root_b / "wh"),
+               "--engine", "cpu", "--state_dir", str(state_dir),
+               "--ledger", "none"]
+        print("+", " ".join(cmd), flush=True)
+        f = open(log_path, "a")
+        return subprocess.Popen(cmd, env=env or base_env(), stdout=f,
+                                stderr=subprocess.STDOUT)
+
+    qd_h = power.get_query_subset(
+        power.gen_sql_from_stream(str(root_b / "streams" /
+                                      "query_1.sql")),
+        ["query3", "query96"])
+
+    # uninterrupted control server: the ground-truth answers
+    sock_ctl = work / "serve_ctl.sock"
+    p_ctl = start_serve(sock_ctl, work / "serve_state_ctl",
+                        work / "h_ctl.log")
+    cli = ServeClient(str(sock_ctl))
+    assert cli.wait_ready(120.0), "control server never got ready"
+    control = [cli.sql(sql, max_rows=100000)["data"]
+               for sql in qd_h.values()]
+    cli.close()
+    p_ctl.terminate()
+    assert p_ctl.wait(timeout=120) == 0, "control drain exited nonzero"
+
+    # chaos server: the first execute wedges in an injected hang, so
+    # the SIGKILL deterministically lands with the query in flight
+    sock_h = work / "serve_h.sock"
+    state_h = work / "serve_state_h"
+    h_log = work / "h_serve.log"
+    p_h = start_serve(
+        sock_h, state_h, h_log,
+        env=base_env(NDSTPU_FAULTS="execute:hang:1.0:seedH:times=1:"
+                                   "hang=60"))
+    cli_h = ServeClient(str(sock_h), retries=30,
+                        connect_timeout_s=180.0)
+    assert cli_h.wait_ready(120.0), "chaos server never got ready"
+    answers: list = []
+
+    def pump():
+        for sql in qd_h.values():
+            answers.append(cli_h.sql(sql, max_rows=100000)["data"])
+
+    th = threading.Thread(target=pump, daemon=True)
+    th.start()
+    t0 = time.time()
+    while "[faults] injected" not in \
+            (h_log.read_text() if h_log.exists() else ""):
+        assert time.time() - t0 < 60, "hang fault never injected"
+        assert p_h.poll() is None, "chaos server died on its own"
+        time.sleep(0.05)
+    p_h.kill()  # SIGKILL mid-hung-query: no drain, no goodbye
+    p_h.wait(timeout=60)
+    print(f"  -> serve SIGKILLed mid-flight after "
+          f"{time.time() - t0:.1f}s; restarting healthy", flush=True)
+    p_h2 = start_serve(sock_h, state_h, h_log)  # same socket + state
+    th.join(240.0)
+    assert not th.is_alive(), \
+        "client never recovered through the server SIGKILL"
+    assert answers == control, \
+        "reconnect-and-retry answers differ from the control server"
+    assert cli_h.retried >= 1, \
+        "client claims it never retried across the kill"
+    cli_h.close()
+    p_h2.terminate()
+    assert p_h2.wait(timeout=120) == 0
+    starts = [r.get("event") for r in
+              read_jsonl(state_h / "serve_journal.jsonl")]
+    assert starts.count("server-start") == 2, starts
+    print("serve SIGKILL scenario OK: client reconnect-retried to "
+          f"control-identical results for {len(control)} queries")
+
+    print("chaos smoke OK: crash + 4 SIGKILLs resumed to "
           "baseline-identical results; permanent fault surfaced "
           "classified")
     shutil.rmtree(work, ignore_errors=True)

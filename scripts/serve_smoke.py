@@ -1,0 +1,313 @@
+"""Serve smoke: the always-on query service under its four fates.
+
+CI gate for ndstpu/serve (docs/ROBUSTNESS.md "Serving lifecycle").
+One tiny warehouse, a serial ``power.py`` ground truth, then four
+server runs:
+
+1. **Clean** — 3 concurrent clients through ``throughput --mode
+   serve`` produce per-query parquet outputs **byte-identical** to the
+   serial power runs (same writer, same engine, shared-session serving
+   must change nothing).
+2. **Dispatch faults** — a server booted with guaranteed
+   ``serve.dispatch`` transient faults: the injected failures reach
+   the CLIENT as typed transient errors and its retry loop converges
+   to results byte-identical to serial anyway.
+3. **SIGTERM drain** — a query is sent, and while it is in flight the
+   server gets SIGTERM: the in-flight query still completes with an
+   ok response (zero dropped), follow-up requests get the typed
+   draining answer, the process exits 0, and the journal ends with the
+   clean-shutdown marker.
+4. **SIGKILL + warm restart** — the server is kill -9'd mid-flight;
+   the blocked client reconnects-and-retries into the restarted
+   server and completes; a seen-shape query after restart compiles
+   NOTHING new (``engine.cache.compiled.miss`` delta == 0 over the
+   ``stats`` op) and returns the pre-kill answer.
+
+Engine is ``tpu`` (jaxexec; runs on the CPU platform under
+``JAX_PLATFORMS=cpu``) so the compile cache — the thing warm restart
+exists to preserve — is actually in play.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SUBQ = "query3,query96"
+STREAMS = ("1", "2", "3")
+
+
+def env_for(**extra) -> dict:
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("NDSTPU_FAULTS", None)
+    env.update({k: v for k, v in extra.items() if v is not None})
+    return env
+
+
+def run(cmd, **kw):
+    print("+", " ".join(map(str, cmd)), flush=True)
+    return subprocess.run([str(c) for c in cmd], **kw)
+
+
+def start_server(root: pathlib.Path, tag: str, sock: pathlib.Path,
+                 out: pathlib.Path, faults_spec=None,
+                 timeout_s=None) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "ndstpu.harness.serve", "server",
+           "--socket", sock, "--input_prefix", root / "wh",
+           "--engine", "tpu", "--output_prefix", out,
+           "--output_format", "parquet",
+           "--state_dir", root / f"state_{tag}",
+           "--ledger", root / f"ledger_{tag}.jsonl",
+           "--slots", "2"]
+    if timeout_s is not None:
+        cmd += ["--query_timeout_s", str(timeout_s)]
+    log = open(root / f"server_{tag}.log", "a")  # restart appends
+    print("+", " ".join(map(str, cmd)),
+          f"   [NDSTPU_FAULTS={faults_spec}]" if faults_spec else "",
+          flush=True)
+    return subprocess.Popen(
+        [str(c) for c in cmd], env=env_for(NDSTPU_FAULTS=faults_spec),
+        stdout=log, stderr=subprocess.STDOUT)
+
+
+def client(sock, **kw):
+    from ndstpu.serve.client import ServeClient
+    cli = ServeClient(str(sock), **kw)
+    assert cli.wait_ready(180.0), f"server on {sock} never got ready"
+    return cli
+
+
+def parquet_tree(prefix: pathlib.Path) -> dict:
+    """relpath -> bytes for every parquet part under prefix."""
+    return {str(p.relative_to(prefix)): p.read_bytes()
+            for p in sorted(prefix.rglob("part-0.parquet"))}
+
+
+def assert_byte_identical(got: pathlib.Path, want: pathlib.Path,
+                          leg: str) -> int:
+    g, w = parquet_tree(got), parquet_tree(want)
+    assert set(g) == set(w), \
+        f"{leg}: output sets differ: {sorted(set(g) ^ set(w))}"
+    for rel in w:
+        assert g[rel] == w[rel], \
+            f"{leg}: {rel} differs from the serial power run"
+    return len(w)
+
+
+def journal_events(path: pathlib.Path) -> list:
+    events = []
+    for line in path.read_text().splitlines():
+        try:
+            events.append(json.loads(line).get("event"))
+        except ValueError:
+            pass  # torn tail from the SIGKILL leg
+    return events
+
+
+def main() -> int:
+    root = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_serve_smoke"))
+    py = [sys.executable, "-m"]
+    run(py + ["ndstpu.datagen.driver", "local", "0.002", "2",
+              root / "raw"], check=True, env=env_for())
+    run(py + ["ndstpu.io.transcode", "--input_prefix", root / "raw",
+              "--output_prefix", root / "wh",
+              "--report_file", root / "load.txt",
+              "--output_format", "ndslake"],
+        check=True, env=env_for(), stdout=subprocess.DEVNULL)
+    run(py + ["ndstpu.queries.streamgen", "--output_dir",
+              root / "streams", "--rngseed", "07291122510",
+              "--streams", "4"],  # query_0 is the power stream; we
+        # drive 3 concurrent serve clients off streams 1..3
+        check=True, env=env_for(), stdout=subprocess.DEVNULL)
+
+    # ---- serial ground truth: power.py, one stream at a time --------
+    serial = root / "serial_out"
+    for sid in STREAMS:
+        run(py + ["ndstpu.harness.power",
+                  root / "streams" / f"query_{sid}.sql", root / "wh",
+                  root / f"serial_time_{sid}.csv",
+                  "--engine", "tpu", "--input_format", "ndslake",
+                  "--output_prefix", serial / f"query_{sid}",
+                  "--sub_queries", SUBQ],
+            check=True, env=env_for(), stdout=subprocess.DEVNULL)
+    n_outputs = len(parquet_tree(serial))
+    assert n_outputs == len(STREAMS) * len(SUBQ.split(",")), \
+        f"serial baseline wrote {n_outputs} outputs"
+
+    from ndstpu.harness import power
+    from ndstpu.serve.client import ServeClient, ServerDraining
+
+    # ---- leg 1: clean — concurrent clients == serial, bytewise ------
+    sock1 = root / "s1.sock"
+    out1 = root / "serve_out1"
+    srv1 = start_server(root, "leg1", sock1, out1)
+    try:
+        r = run(py + ["ndstpu.harness.throughput", "1,2,3",
+                      "--concurrent", "3", "--mode", "serve",
+                      "--serve_socket", sock1,
+                      "--overlap_report", root / "overlap_serve.json",
+                      "--", sys.executable, "-m",
+                      "ndstpu.harness.power",
+                      str(root / "streams") + "/query_{}.sql",
+                      root / "wh", str(root) + "/serve_time_{}.csv",
+                      "--input_format", "ndslake",
+                      "--output_prefix", out1,
+                      "--sub_queries", SUBQ], env=env_for())
+        assert r.returncode == 0, f"throughput --mode serve rc={r.returncode}"
+        n = assert_byte_identical(out1, serial, "leg1")
+        ov = json.loads((root / "overlap_serve.json").read_text())
+        assert ov["format"] == "ndstpu-throughput-overlap-v1"
+        assert ov["mode"] == "serve"
+        assert all(s["returncode"] == 0 for s in ov["streams"])
+        assert all(s["failures"] == 0 for s in ov["streams"])
+        print(f"leg 1 OK: {n} concurrent-serve outputs byte-identical "
+              f"to serial power")
+    finally:
+        srv1.send_signal(signal.SIGTERM)
+        srv1.wait(timeout=120)
+
+    # ---- leg 2: injected serve.dispatch faults, client retries ------
+    sock2 = root / "s2.sock"
+    out2 = root / "serve_out2"
+    srv2 = start_server(root, "leg2", sock2, out2,
+                        faults_spec="serve.dispatch:transient:1:seedS:times=3")
+    try:
+        cli = client(sock2, retries=8)
+        qd = power.get_query_subset(
+            power.gen_sql_from_stream(root / "streams" / "query_1.sql"),
+            SUBQ.split(","))
+        for qname, sql in qd.items():
+            resp = cli.sql(sql, name=f"query_1/{qname}")
+            assert resp["status"] == "ok", resp
+        assert cli.retried >= 1, \
+            "dispatch faults were injected but the client never retried"
+        cli.close()
+        got = parquet_tree(out2)
+        want = parquet_tree(serial)
+        for rel in got:
+            assert got[rel] == want[rel], \
+                f"leg2: {rel} differs from serial after faulted retries"
+        assert len(got) == len(SUBQ.split(","))
+        log2 = (root / "server_leg2.log").read_text()
+        assert "[faults] injected" in log2, \
+            "server log records no injected dispatch fault"
+        print(f"leg 2 OK: client retried through {cli.retried} "
+              f"injected dispatch faults to serial-identical bytes")
+    finally:
+        srv2.send_signal(signal.SIGTERM)
+        srv2.wait(timeout=120)
+
+    # ---- leg 3: SIGTERM drain with a query in flight ----------------
+    sock3 = root / "s3.sock"
+    srv3 = start_server(root, "leg3", sock3, root / "serve_out3")
+    qd = power.get_query_subset(
+        power.gen_sql_from_stream(root / "streams" / "query_1.sql"),
+        SUBQ.split(","))
+    (q1_name, q1_sql), (q2_name, _) = list(qd.items())[:2]
+    cli = client(sock3, retries=2, connect_timeout_s=5.0)
+    got: dict = {}
+
+    def inflight():
+        # fresh server: the first query compiles, so it is still in
+        # flight when the SIGTERM below lands mid-execution
+        got["resp"] = cli.sql(q1_sql, name=f"drain/{q1_name}")
+
+    th = threading.Thread(target=inflight, daemon=True)
+    th.start()
+    time.sleep(0.5)
+    srv3.send_signal(signal.SIGTERM)
+    th.join(180.0)
+    assert not th.is_alive(), "in-flight query never answered"
+    assert got["resp"]["status"] == "ok", \
+        f"in-flight query dropped by drain: {got['resp']}"
+    # post-drain requests get the typed draining answer (or a closed
+    # socket once the server is fully gone) — never silence
+    try:
+        cli.sql("SELECT 1", name=q2_name)
+        raise AssertionError("post-drain request was accepted")
+    except (ServerDraining, OSError, ConnectionError):
+        pass
+    cli.close()
+    assert srv3.wait(timeout=120) == 0, \
+        f"SIGTERM drain exited rc={srv3.returncode}"
+    ev3 = journal_events(root / "state_leg3" / "serve_journal.jsonl")
+    assert ev3[-1] == "clean-shutdown", ev3
+    assert "query" in ev3, "drained run journaled no queries"
+    print("leg 3 OK: SIGTERM drained with the in-flight query "
+          "answered, rc=0, clean-shutdown journaled")
+
+    # ---- leg 4: SIGKILL mid-flight + warm restart, zero compiles ----
+    sock4 = root / "s4.sock"
+    out4 = root / "serve_out4"
+    srv4 = start_server(root, "leg4", sock4, out4)
+    cli = client(sock4)
+    first = cli.sql(qd[q1_name])  # collect mode: data comes back
+    assert first["status"] == "ok"
+    cli.close()
+    kill_cli = ServeClient(str(sock4), retries=30,
+                           connect_timeout_s=180.0)
+    killed: dict = {}
+
+    def through_the_kill():
+        killed["resp"] = kill_cli.sql(qd[q2_name])
+
+    th = threading.Thread(target=through_the_kill, daemon=True)
+    th.start()
+    time.sleep(0.3)
+    srv4.kill()  # SIGKILL: no drain, no flush — the journal and the
+    srv4.wait(timeout=60)  # incremental compile records are all that survive
+    print(f"leg 4: SIGKILLed pid {srv4.pid} mid-flight; restarting")
+    srv4b = start_server(root, "leg4", sock4, out4)  # same state_dir
+    try:
+        th.join(300.0)
+        assert not th.is_alive(), \
+            "client never recovered through the SIGKILL"
+        assert killed["resp"]["status"] == "ok", killed["resp"]
+        assert kill_cli.retried >= 1, \
+            "mid-kill client reports no reconnect/retry"
+        kill_cli.close()
+
+        cli2 = client(sock4)
+        miss_before = cli2.request({"op": "stats"})["counters"].get(
+            "engine.cache.compiled.miss", 0)
+        again = cli2.sql(qd[q1_name])  # seen shape, pre-kill compile
+        miss_after = cli2.request({"op": "stats"})["counters"].get(
+            "engine.cache.compiled.miss", 0)
+        assert again["status"] == "ok"
+        assert again["data"] == first["data"], \
+            "warm-restarted answer differs from the pre-kill answer"
+        assert miss_after == miss_before, \
+            (f"warm restart recompiled a seen shape: compiled.miss "
+             f"{miss_before} -> {miss_after}")
+        ev4 = journal_events(root / "state_leg4" /
+                             "serve_journal.jsonl")
+        # two boots, and the first one never got to mark itself clean
+        assert ev4.count("server-start") == 2
+        assert "clean-shutdown" not in ev4
+        cli2.close()
+        print("leg 4 OK: client reconnect-retried through SIGKILL; "
+              "seen-shape query after warm restart compiled nothing "
+              f"(miss {miss_before} -> {miss_after})")
+    finally:
+        srv4b.send_signal(signal.SIGTERM)
+        srv4b.wait(timeout=120)
+
+    print("serve smoke OK: clean parity, faulted-retry parity, "
+          "SIGTERM drain, SIGKILL warm restart all held")
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

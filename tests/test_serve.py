@@ -1,0 +1,424 @@
+"""Serve layer: protocol, admission/overload edges, drain, warm restart.
+
+The satellite coverage the issue names explicitly:
+
+* drain with a hung in-flight query hits the watchdog path (abandon on
+  a zombie thread + fresh-session swap) instead of blocking shutdown;
+* a tenant at budget gets the typed ``Rejected`` while other tenants
+  proceed;
+* a tripped circuit breaker recovers after its cooldown (half-open
+  probe) — tripped off the PR 5 quarantine list, per canonical key.
+
+Plus the protocol/scheduler/lifecycle seams the server composes:
+length-prefixed framing, continuous-feed StreamScheduler streams,
+connection-fault taxonomy, journal replay, and the warm-restart
+zero-new-compiles invariant the serve smoke proves cross-process.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ndstpu import faults, obs
+from ndstpu.engine.columnar import INT32, Column, Table
+from ndstpu.engine.session import Session
+from ndstpu.faults import taxonomy
+from ndstpu.harness.scheduler import StreamScheduler
+from ndstpu.io import atomic
+from ndstpu.io.loader import Catalog
+from ndstpu.obs import artifact_lint
+from ndstpu.serve import lifecycle, protocol
+from ndstpu.serve.client import ServeClient
+from ndstpu.serve.overload import (AdmissionQueue, CircuitBreaker,
+                                   Overloaded, Rejected, TenantBudgets)
+from ndstpu.serve.server import QueryServer, ServeConfig
+
+
+def col_i32(vals):
+    return Column(np.asarray(vals, dtype=np.int32), INT32, None)
+
+
+def tiny_session(backend: str = "cpu") -> Session:
+    cat = Catalog()
+    cat.register("t", Table({
+        "a": col_i32(list(range(10))),
+        "b": col_i32([v % 3 for v in range(10)]),
+    }))
+    return Session(cat, backend=backend)
+
+
+@pytest.fixture
+def serve_env(tmp_path):
+    """A started server over a tiny cpu session + one client; drains
+    on teardown.  Yields a factory so tests can tune ServeConfig."""
+    made = []
+
+    def make(session=None, **cfg):
+        defaults = dict(
+            socket_path=str(tmp_path / f"s{len(made)}.sock"),
+            engine="cpu",
+            output_prefix=str(tmp_path / f"out{len(made)}"),
+            journal_path=str(tmp_path / f"journal{len(made)}.jsonl"),
+            slo_path=str(tmp_path / f"SLO{len(made)}.json"),
+            ledger_path="none",
+            query_timeout_s=30.0)
+        defaults.update(cfg)
+        srv = QueryServer(ServeConfig(**defaults),
+                          session=session or tiny_session(
+                              defaults["engine"]))
+        srv.start()
+        cli = ServeClient(defaults["socket_path"], retries=4,
+                          connect_timeout_s=10.0)
+        assert cli.wait_ready(10.0)
+        made.append((srv, cli))
+        return srv, cli
+
+    yield make
+    for srv, cli in made:
+        cli.close()
+        if not srv.draining:
+            srv.drain(reason="teardown")
+
+
+# -- protocol ----------------------------------------------------------------
+
+def test_protocol_roundtrip_and_bounds():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "sql", "sql": "SELECT 1; -- '\n\x00 unicode ☃"}
+        protocol.send_msg(a, msg)
+        assert protocol.recv_msg(b) == msg
+        a.close()
+        assert protocol.recv_msg(b) is None  # clean EOF
+    finally:
+        b.close()
+    c, d = socket.socketpair()
+    try:
+        c.sendall(b"\x7f\xff\xff\xff")  # absurd length prefix
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_msg(d)
+    finally:
+        c.close()
+        d.close()
+
+
+def test_protocol_truncated_frame_is_error():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x10partial")
+        a.close()
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_msg(b)
+    finally:
+        b.close()
+
+
+# -- connection-fault taxonomy (satellite 1) ---------------------------------
+
+def test_connection_faults_classify_transient():
+    assert taxonomy.classify(socket.timeout("timed out")) == "transient"
+    assert taxonomy.classify(
+        ConnectionRefusedError("connection refused")) == "transient"
+    assert taxonomy.classify(ConnectionResetError()) == "transient"
+    assert taxonomy.classify(BrokenPipeError()) == "transient"
+    # pre-3.10 socket.timeout pickles/paths carry the bare class name
+    assert taxonomy.classify_name("timeout", "") == "transient"
+    assert taxonomy.classify_name(
+        "SomeWrapperError", "upstream: Connection refused") == "transient"
+    assert taxonomy.classify_name(
+        "SomeWrapperError", "Broken pipe on fd 7") == "transient"
+
+
+# -- continuous-feed scheduler ----------------------------------------------
+
+def test_scheduler_continuous_feed():
+    sched = StreamScheduler({})
+    view = sched.open_stream("c1")
+    sched.feed("c1", "q1", "SELECT 1")
+    sched.feed("c1", "q2", "SELECT 2")
+    assert view.next(0.0) in ("q1", "q2")
+    view.done("q1")
+    got = []
+
+    def drain_view():
+        while True:
+            n = view.next(0.0)
+            if n is None:
+                return
+            got.append(n)
+            view.done(n)
+
+    th = threading.Thread(target=drain_view, daemon=True)
+    th.start()
+    time.sleep(0.1)
+    sched.feed("c1", "q3", "SELECT 3")  # wakes the blocked next()
+    time.sleep(0.2)
+    sched.close("c1")
+    th.join(5.0)
+    assert not th.is_alive()
+    assert set(got) == {"q2", "q3"}
+    with pytest.raises(ValueError):
+        sched.feed("c1", "q4", "SELECT 4")  # closed stream
+
+
+def test_scheduler_feed_dedups_across_streams():
+    sched = StreamScheduler(
+        {}, key_fn=lambda s: " ".join(s.lower().split()))
+    sched.open_stream("a")
+    sched.open_stream("b")
+    sched.feed("a", "qa", "SELECT * FROM t")
+    sched.feed("b", "qb", "select  *  from  t")  # same normalized key
+    va, vb = sched.view("a"), sched.view("b")
+    assert va.next(0.0) == "qa"
+    # b's identical text is classed in-flight-elsewhere, still runnable
+    assert vb.next(0.0) == "qb"
+    va.done("qa")
+    assert sched._key[("a", "qa")] == sched._key[("b", "qb")]
+    assert sched._key[("a", "qa")] in sched.compiled
+
+
+# -- overload primitives -----------------------------------------------------
+
+def test_tenant_budget_isolation():
+    clock = [0.0]
+    budgets = TenantBudgets(capacity=2, refill_per_s=1.0,
+                            clock=lambda: clock[0])
+    budgets.acquire("a")
+    budgets.acquire("a")
+    with pytest.raises(Rejected) as ei:
+        budgets.acquire("a")
+    assert ei.value.reason == "tenant-budget"
+    budgets.acquire("b")  # other tenants unaffected
+    clock[0] += 1.5  # refill restores tenant a
+    budgets.acquire("a")
+
+
+def test_admission_queue_overload_and_deadline_shed():
+    q = AdmissionQueue(depth=2, est_wait_s=1.0)
+    q.admit()
+    q.admit(deadline_s=10.0)
+    with pytest.raises(Overloaded) as ei:
+        q.admit()
+    assert ei.value.retry_after_s > 0
+    q.release()
+    with pytest.raises(Rejected) as ei:  # 1 ahead * 1s > 0.5s deadline
+        q.admit(deadline_s=0.5)
+    assert ei.value.reason == "deadline"
+    q.admit(deadline_s=5.0)
+
+
+def test_circuit_breaker_trips_and_recovers_after_cooldown():
+    clock = [0.0]
+    quarantine = faults.Quarantine(max_failures=1)
+    cb = CircuitBreaker(quarantine, cooldown_s=10.0,
+                        clock=lambda: clock[0])
+    cb.check("fp1")  # closed: no-op
+    quarantine.note_failure("fp1", "permanent")
+    assert cb.note_failure("fp1") is True  # quarantined -> trips
+    assert cb.state("fp1") == "open"
+    with pytest.raises(Rejected) as ei:
+        cb.check("fp1")
+    assert ei.value.reason == "circuit-open"
+    clock[0] += 11.0  # past cooldown: half-open, one probe admitted
+    assert cb.state("fp1") == "half-open"
+    cb.check("fp1")
+    with pytest.raises(Rejected):
+        cb.check("fp1")  # second concurrent probe rejected
+    cb.note_success("fp1")  # probe succeeded -> closed
+    assert cb.state("fp1") == "closed"
+    cb.check("fp1")
+    # and an unpoisoned failure never trips
+    assert cb.note_failure("fp2") is False
+    cb.check("fp2")
+
+
+# -- server end-to-end -------------------------------------------------------
+
+def test_sql_roundtrip_output_and_journal(serve_env):
+    srv, cli = serve_env()
+    r = cli.sql("SELECT a, b FROM t WHERE a < 4 ORDER BY a")
+    assert r["rows"] == 4 and r["data"][0] == [0, 0]
+    r2 = cli.sql("SELECT sum(a) AS s FROM t", name="q_out")
+    assert r2["rows"] == 1
+    assert os.path.exists(os.path.join(
+        srv.config.output_prefix, "q_out", "part-0.csv"))
+    events = [rec["event"] for rec in
+              atomic.read_jsonl(srv.config.journal_path)]
+    assert events[0] == lifecycle.JOURNAL_START
+    assert events.count(lifecycle.JOURNAL_QUERY) == 2
+    health = cli.health()
+    assert health["ready"] and health["ok"] >= 2
+
+
+def test_bad_sql_is_permanent_error(serve_env):
+    _, cli = serve_env()
+    from ndstpu.serve.client import ServeError
+    with pytest.raises(ServeError) as ei:
+        cli.sql("SELEKT nope")
+    assert ei.value.taxonomy == "permanent"
+
+
+def test_tenant_at_budget_rejected_while_others_proceed(serve_env):
+    _, cli = serve_env(tenant_tokens=2, tenant_refill_per_s=0.001)
+    cli.sql("SELECT count(*) AS c FROM t", tenant="greedy")
+    cli.sql("SELECT count(*) AS c FROM t", tenant="greedy")
+    with pytest.raises(Rejected) as ei:
+        cli.sql("SELECT count(*) AS c FROM t", tenant="greedy")
+    assert ei.value.reason == "tenant-budget"
+    # the other tenant is untouched by greedy's exhaustion
+    r = cli.sql("SELECT count(*) AS c FROM t", tenant="modest")
+    assert r["status"] == "ok"
+
+
+def test_dispatch_fault_is_client_visible_and_retried(serve_env):
+    _, cli = serve_env()
+    faults.install("serve.dispatch:transient:1:times=1")
+    try:
+        before = obs.counters_snapshot()
+        r = cli.sql("SELECT max(a) AS m FROM t")
+        assert r["status"] == "ok"
+        delta = obs.counter_delta(before)
+        assert delta.get(
+            "faults.injected.serve.dispatch.transient") == 1
+        # the CLIENT retried — the server deliberately does not absorb
+        # dispatch faults (that is what distinguishes the site from
+        # `execute`, which run_with_retry absorbs server-side)
+        assert cli.retried >= 1
+        assert delta.get("serve.errors") == 1
+        assert delta.get("serve.ok") == 1
+    finally:
+        faults.uninstall()
+
+
+def test_drain_with_hung_query_hits_watchdog(serve_env):
+    """A wedged in-flight query must not block SIGTERM drain: the
+    watchdog abandons it on a zombie thread, swaps a fresh session,
+    and the retry completes the request — zero dropped queries."""
+    srv, cli = serve_env(query_timeout_s=0.5)
+    faults.install("execute:hang:1:times=1:hang=8")
+    try:
+        before = obs.counters_snapshot()
+        got = {}
+
+        def send():
+            got["resp"] = cli.sql("SELECT min(a) AS m FROM t")
+
+        th = threading.Thread(target=send, daemon=True)
+        th.start()
+        time.sleep(0.2)  # let the query wedge in the hang
+        t0 = time.time()
+        summary = srv.drain(reason="SIGTERM-test")
+        drain_wall = time.time() - t0
+        th.join(15.0)
+        assert not th.is_alive()
+        # the hung attempt was abandoned, the retry answered the client
+        assert got["resp"]["status"] == "ok"
+        assert got["resp"]["attempts"] >= 2
+        delta = obs.counter_delta(before)
+        assert delta.get("serve.watchdog.abandoned", 0) >= 1
+        assert drain_wall < 8.0, \
+            f"drain blocked {drain_wall:.1f}s behind a hung query"
+        assert summary["reason"] == "SIGTERM-test"
+        events = [rec["event"] for rec in
+                  atomic.read_jsonl(srv.config.journal_path)]
+        assert events[-1] == lifecycle.JOURNAL_CLEAN
+    finally:
+        faults.uninstall()
+
+
+def test_draining_rejects_new_requests(serve_env):
+    srv, cli = serve_env()
+    cli.sql("SELECT 1 AS one FROM t")
+    srv.draining = True  # admission stopped, socket still up
+    from ndstpu.serve.client import ServerDraining
+    with pytest.raises(ServerDraining):
+        cli.sql("SELECT 2 AS two FROM t")
+    srv.draining = False
+
+
+# -- lifecycle: journal replay + warm restart --------------------------------
+
+def test_journal_replay_state(tmp_path):
+    j = lifecycle.ServeJournal(str(tmp_path / "j.jsonl"))
+    assert j.replay_state() == {"sqls": [], "clean": True}
+    j.mark_start()
+    j.mark_query("q1", "SELECT 1", canon_key="k1")
+    j.mark_query("q1", "SELECT 1")  # dedup
+    j.mark_query("q2", "SELECT 2")
+    state = lifecycle.ServeJournal(str(tmp_path / "j.jsonl")) \
+        .replay_state()
+    assert [r["sql"] for r in state["sqls"]] == ["SELECT 1", "SELECT 2"]
+    assert state["clean"] is False  # started, never marked clean
+    j.mark_clean_shutdown()
+    state = lifecycle.ServeJournal(str(tmp_path / "j.jsonl")) \
+        .replay_state()
+    assert state["clean"] is True
+
+
+def test_warm_restart_zero_new_compiles(tmp_path):
+    """The serve_smoke leg-4 invariant, in-process: a restarted server
+    answering a previously-seen plan shape compiles NOTHING new
+    (engine.cache.compiled.miss stays flat)."""
+    records = str(tmp_path / "records.json")
+    journal = str(tmp_path / "j.jsonl")
+    sql = "SELECT b, sum(a) AS s FROM t GROUP BY b ORDER BY b"
+    cfg = dict(socket_path=str(tmp_path / "warm.sock"),
+               engine="tpu", compile_records=records,
+               journal_path=journal, ledger_path="none",
+               query_timeout_s=60.0)
+
+    srv1 = QueryServer(ServeConfig(**cfg), session=tiny_session("tpu"))
+    srv1.start()
+    cli = ServeClient(cfg["socket_path"])
+    assert cli.wait_ready(10.0)
+    r1 = cli.sql(sql)
+    cli.close()
+    # no clean drain: simulate the SIGKILL by never calling drain() —
+    # the incremental persistence must already have saved the records
+    assert os.path.exists(records)
+    srv1._listener.close()
+
+    cfg2 = dict(cfg, socket_path=str(tmp_path / "warm2.sock"))
+    srv2 = QueryServer(ServeConfig(**cfg2),
+                       session=tiny_session("tpu"))
+    srv2.start()
+    cli2 = ServeClient(cfg2["socket_path"])
+    assert cli2.wait_ready(10.0)
+    before = obs.counters_snapshot()
+    r2 = cli2.sql(sql)
+    delta = obs.counter_delta(before)
+    cli2.close()
+    srv2.drain(reason="test")
+    assert r2["data"] == r1["data"]
+    assert delta.get("engine.cache.compiled.miss", 0) == 0, \
+        f"warm restart recompiled: {delta}"
+    assert delta.get("engine.cache.compiled.hit", 0) >= 1
+
+
+# -- SLO artifact ------------------------------------------------------------
+
+def test_slo_tracker_percentiles_and_export(tmp_path):
+    slo = lifecycle.SLOTracker()
+    for ms in range(1, 101):
+        slo.record("a", ms / 1000.0, "ok")
+    slo.record("a", 0.0, "overloaded")
+    slo.record("b", 0.005, "ok")
+    doc = slo.export(str(tmp_path / "SLO.json"))
+    assert doc["artifact"] == lifecycle.SLO_ARTIFACT
+    a = doc["tenants"]["a"]
+    assert a["count"] == 101 and a["overloaded"] == 1
+    assert a["p50_ms"] == pytest.approx(50.0, abs=2.0)
+    assert a["p95_ms"] == pytest.approx(95.0, abs=2.0)
+    assert a["p99_ms"] == pytest.approx(99.0, abs=2.0)
+    assert doc["tenants"]["b"]["p50_ms"] == pytest.approx(5.0, abs=1.0)
+
+
+def test_artifact_lint_recognizes_slo_as_runtime():
+    text = "the server exports `SLO.json` next to its journal"
+    assert artifact_lint.lint_text(text, root="/nonexistent") == []
+    assert any(p == "SLO.json" for _, p, _ in
+               artifact_lint.cited_artifacts(text))
