@@ -23,7 +23,8 @@ import numpy as np
 
 from ndstpu import schema as nds_schema
 from ndstpu.engine import columnar
-from ndstpu.analysis import canon, diagnostics, lowering, spines, typecheck
+from ndstpu.analysis import (
+    canon, cost, diagnostics, lowering, spines, typecheck)
 from ndstpu.analysis.canon import (
     CanonResult, canonicalize, canonicalize_subtrees)
 from ndstpu.analysis.diagnostics import Diagnostic
@@ -33,8 +34,9 @@ from ndstpu.analysis.typecheck import infer_plan
 __all__ = [
     "AnalysisResult", "CanonResult", "Diagnostic", "analyze_plan",
     "analyze_sql", "audit_plan", "canon", "canonicalize",
-    "canonicalize_subtrees", "diagnostics", "infer_plan", "lowering",
-    "schema_catalog", "schema_tables", "spines", "typecheck",
+    "canonicalize_subtrees", "cost", "diagnostics", "infer_plan",
+    "lowering", "schema_catalog", "schema_tables", "spines",
+    "typecheck",
 ]
 
 
@@ -75,6 +77,7 @@ class AnalysisResult:
     schema: typecheck.Schema
     canon: Optional[CanonResult] = None   # plan-shape canonicalization
     spine_sites: Optional[List["spines.SpineSite"]] = None  # NDS5xx pass
+    cost_report: Optional["cost.CostReport"] = None  # NDS6xx pass
 
     @property
     def errors(self) -> List[Diagnostic]:
@@ -91,11 +94,16 @@ def analyze_plan(plan, tables: Optional[Dict[str, object]] = None,
                  query: str = "",
                  scale_factor: Optional[float] = None,
                  spmd: bool = True,
-                 spine_pass: bool = False) -> AnalysisResult:
+                 spine_pass: bool = False,
+                 cost_pass: bool = False) -> AnalysisResult:
     """Run schema inference (NDS1xx) + lowerability audit (NDS2xx/3xx)
     over an optimized logical plan.  ``spine_pass=True`` also classifies
     the plan's candidate common spines (NDS5xx inputs — the per-query
-    half of :func:`spines.build_index`)."""
+    half of :func:`spines.build_index`); ``cost_pass=True`` runs the
+    static cost model (NDS6xx — scripts/cost_lint.py) and attaches its
+    :class:`cost.CostReport` with the NDS6xx findings merged into
+    ``diagnostics``.  The default analysis stays cost-free so the
+    PLAN_LINT baseline and the golden diagnostic sets are unchanged."""
     tables = tables if tables is not None else schema_tables()
     out_schema, type_diags = infer_plan(plan, tables, query=query,
                                         scale_factor=scale_factor)
@@ -106,22 +114,31 @@ def analyze_plan(plan, tables: Optional[Dict[str, object]] = None,
     if spine_pass:
         sites = spines.subtree_sites(plan, tables, query=query,
                                      scale_factor=scale_factor)
+    cost_report = None
+    cost_diags: List[Diagnostic] = []
+    if cost_pass:
+        cost_report = cost.audit_cost(plan, tables, query=query,
+                                      scale_factor=scale_factor)
+        cost_diags = cost_report.diagnostics
     diags = diagnostics.sort_diagnostics(
-        type_diags + audit.diagnostics + list(cres.diagnostics))
+        type_diags + audit.diagnostics + list(cres.diagnostics)
+        + cost_diags)
     return AnalysisResult(query=query, verdict=audit.verdict,
                           diagnostics=diags, schema=out_schema,
-                          canon=cres, spine_sites=sites)
+                          canon=cres, spine_sites=sites,
+                          cost_report=cost_report)
 
 
 def analyze_sql(session, query: str, sql: str,
                 tables: Optional[Dict[str, object]] = None,
                 scale_factor: Optional[float] = None,
                 spmd: bool = True,
-                spine_pass: bool = False) -> AnalysisResult:
+                spine_pass: bool = False,
+                cost_pass: bool = False) -> AnalysisResult:
     """Plan one SQL statement through ``session`` (jax-free path) and
     analyze it.  ``session`` is an ``engine.session.Session`` — usually
     over :func:`schema_catalog` so no data is touched."""
     plan, _cols = session.plan(sql)
     return analyze_plan(plan, tables=tables, query=query,
                         scale_factor=scale_factor, spmd=spmd,
-                        spine_pass=spine_pass)
+                        spine_pass=spine_pass, cost_pass=cost_pass)

@@ -23,6 +23,9 @@ Code ranges (docs/ARCHITECTURE.md "Static analysis"):
 * ``NDS5xx`` — cross-query common-spine sharing (analysis/spines.py):
   which canonical subtrees recur across corpus parts and whether the
   runtime spine-materialization cache may splice them
+* ``NDS6xx`` — static cost model (analysis/cost.py): calibrated
+  cardinality/byte estimates, exchange-placement risk, and
+  static-vs-observed misestimates (swept into COST_LINT.json)
 
 The module is import-hygienic: no jax, no engine imports — it can run in
 a process that never initializes a backend (CI lint, doc tooling).
@@ -106,6 +109,19 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NDS504": ("info", "estimated spine bytes exceed the memory-planner "
                        "budget (memplan row-width model): materialization "
                        "would not be admitted"),
+    # -- NDS6xx static cost model -----------------------------------------
+    "NDS601": ("warning", "broadcast build side over the replication "
+                          "byte budget (cost model demotes it to the "
+                          "shuffle path)"),
+    "NDS602": ("warning", "spill-risk working set: predicted per-device "
+                          "bytes exceed the device budget (fact must "
+                          "stream out-of-core)"),
+    "NDS603": ("info", "exchange-heavy plan: predicted collective "
+                       "(all_to_all) bytes over the heavy-traffic "
+                       "threshold"),
+    "NDS604": ("info", "misestimate: static cardinality estimate vs "
+                       "ledger-observed output beyond the calibration "
+                       "threshold"),
 }
 
 _SEV_ORDER = {"error": 0, "warning": 1, "info": 2}

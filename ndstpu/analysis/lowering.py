@@ -538,7 +538,18 @@ class LoweringAuditor:
                                  chain[spine_idx - 1][1])
             spine = chain[spine_idx - 1][0]
             spine_path = chain[spine_idx - 1][1]
-        broadcast = shuffle = 0
+        # exchange placement now comes from the cost model's estimated
+        # build cardinality/bytes through the SAME choose_strategy the
+        # runtime advisor uses (analysis/cost.py), not the old
+        # fact-in-build structural proxy — NDS305 reports the predicted
+        # strategy mix plus the estimated replicated build bytes
+        from ndstpu.analysis import cost as costmod
+        model = costmod.CostModel(self.tables,
+                                  scale_factor=self.tc.scale_factor,
+                                  query=self.query)
+        budget, _src = costmod.cost_budget_bytes()
+        broadcast = shuffle = reduced = 0
+        bcast_bytes = 0
         for node, npath in self._walk_with_paths(spine, spine_path):
             if not isinstance(node, lp.Join):
                 continue
@@ -586,17 +597,33 @@ class LoweringAuditor:
                         self._emit("NDS312", "string join key shards "
                                    "on frozen global-dictionary codes",
                                    f"{npath}/keys[{i}]")
-            if any(isinstance(n, lp.Scan) and
-                   n.table in SPMD_FACT_TABLES for n in build.walk()):
+            est = model.estimate(build)
+            reducible = (
+                node.kind in SPMD_REDUCIBLE_BUILD_JOIN_KINDS
+                and not (node.kind == "nullaware_anti"
+                         and node.extra is not None)
+                and any(isinstance(n, lp.Scan)
+                        and n.table in SPMD_FACT_TABLES
+                        for n in build.walk()))
+            d = costmod.choose_strategy(
+                est.rows, est.bytes,
+                broadcast_limit_rows=SPMD_BROADCAST_LIMIT_ROWS,
+                budget_bytes=budget, reducible=reducible)
+            if d.strategy == "shuffle":
                 shuffle += 1
+            elif d.strategy == "build-reduce":
+                reduced += 1
             else:
                 broadcast += 1
-        if broadcast or shuffle:
+                if est.bytes is not None:
+                    bcast_bytes += est.bytes
+        if broadcast or shuffle or reduced:
             self._emit(
                 "NDS305",
                 f"predicted exchange placement over {target.table}: "
-                f"{broadcast} broadcast join(s), {shuffle} shuffle "
-                "(all_to_all) join(s)", spine_path)
+                f"{broadcast} broadcast join(s) (~{bcast_bytes} est "
+                f"build B), {shuffle} shuffle (all_to_all) join(s), "
+                f"{reduced} build-reduce join(s)", spine_path)
         if isinstance(spine, lp.Aggregate):
             return
         # mirror dplan._split's tail/window detection: a Sort+Limit (or

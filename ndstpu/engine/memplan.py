@@ -128,7 +128,8 @@ def plan_stream(n_rows: int, bytes_per_row: int, n_dev: int,
                 budget_bytes: Optional[int] = None,
                 budget_source: str = "caller",
                 max_depth: int = DEFAULT_MAX_DEPTH,
-                dict_bytes: int = 0) -> StreamPlan:
+                dict_bytes: int = 0,
+                resident_bytes: int = 0) -> StreamPlan:
     """Size ``chunk_rows`` (total across the mesh) and the prefetch
     depth for streaming ``n_rows`` of ``bytes_per_row`` over ``n_dev``
     devices under the per-device budget.
@@ -137,10 +138,17 @@ def plan_stream(n_rows: int, bytes_per_row: int, n_dev: int,
     columns' frozen global dictionaries (codes stream per chunk, but
     the dictionary itself is a whole-query constant on every device),
     carved out of the usable budget before chunks are sized.
+
+    ``resident_bytes`` is the predicted whole-query working set pinned
+    on every device beyond the streamed chunk itself — today the
+    broadcast-join build sides the cost advisor placed resident
+    (analysis/cost.py) — carved out the same way, so a query with fat
+    replicated builds streams in smaller chunks instead of spilling.
     """
     if budget_bytes is None:
         budget_bytes, budget_source = device_budget_bytes()
-    usable = max(int(budget_bytes * SAFETY) - max(int(dict_bytes), 0), 1)
+    usable = max(int(budget_bytes * SAFETY) - max(int(dict_bytes), 0)
+                 - max(int(resident_bytes), 0), 1)
     bytes_per_row = max(bytes_per_row, 1)
     shard_rows = -(-max(n_rows, 1) // max(n_dev, 1))
     if shard_rows * bytes_per_row * COMPUTE_MULT <= usable:

@@ -570,6 +570,7 @@ class Session:
                     kw["chunk_rows"] = self.spmd_chunk_rows
                 if self.spmd_prefetch_depth is not None:
                     kw["prefetch_depth"] = self.spmd_prefetch_depth
+                kw["cost_advisor"] = self._cost_advisor()
                 exe = dplan.DistributedPlanExecutor(
                     self.catalog, self._mesh(), **kw)
                 out = exe.execute_plan(spmd_plan, params=spmd_params)
@@ -680,6 +681,21 @@ class Session:
                 else pmesh.default_mesh()
             self._mesh_cache = m
         return m
+
+    def _cost_advisor(self):
+        """Session-cached exchange-placement advisor (analysis/cost.py)
+        for the distributed executors; re-checks the NDSTPU_COST kill
+        switch per query so tests may flip it around one session, but
+        probes the device budget only once."""
+        from ndstpu.analysis import cost
+        if not cost.enabled():
+            return None
+        adv = getattr(self, "_cost_advisor_cache", None)
+        if adv is None:
+            from ndstpu.analysis import lowering as lowreg
+            adv = cost.default_advisor(lowreg.SPMD_BROADCAST_LIMIT_ROWS)
+            self._cost_advisor_cache = adv
+        return adv
 
     def canonical_key(self, text: str) -> str:
         """Structure-first dedup key for a query text: the canonical

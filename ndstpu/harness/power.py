@@ -168,6 +168,11 @@ def run_one_query(session: Session, query: str, query_name: str,
     result = session.sql(query)
     if result is None:
         return
+    # observed output cardinality on the query span -> ledger extra:
+    # the calibration source for the static cost model
+    # (scripts/cost_lint.py --calibrate, NDS604)
+    from ndstpu import obs
+    obs.annotate(result_rows=int(result.num_rows))
     if not output_path:
         result.to_rows()  # the collect() analog — materialize to host
         return
@@ -780,6 +785,13 @@ def run_query_stream(args) -> None:
                         "spine_bytes_saved":
                             (q.get("attrs") or {}).get(
                                 "spine_bytes_saved"),
+                        # cost-model consumers: the advisor's exchange
+                        # decisions and the observed output cardinality
+                        # (NDS604 calibration, scripts/cost_lint.py)
+                        "cost_decisions":
+                            (q.get("attrs") or {}).get("cost_decisions"),
+                        "result_rows":
+                            (q.get("attrs") or {}).get("result_rows"),
                     }.items() if v})
                     for q in qsums
                     if not (q.get("attrs") or {}).get("error")]

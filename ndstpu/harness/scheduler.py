@@ -672,6 +672,10 @@ def _export_inproc_run(streams, results, errors, records, overlap_doc,
                         (q.get("attrs") or {}).get("spine_hits"),
                     "spine_bytes_saved":
                         (q.get("attrs") or {}).get("spine_bytes_saved"),
+                    "cost_decisions":
+                        (q.get("attrs") or {}).get("cost_decisions"),
+                    "result_rows":
+                        (q.get("attrs") or {}).get("result_rows"),
                 }.items() if v})
                 for q in qsums
                 if not (q.get("attrs") or {}).get("error")]

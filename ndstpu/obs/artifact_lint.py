@@ -7,7 +7,8 @@ suite via tests/test_doc_lint.py):
    STATUS.md) for cited artifact paths (``docs/*.json``/``docs/*.csv``
    and root ``BENCH_*.json`` / ``PLAN_LINT.json`` / ``PLAN_LINT.md`` /
    ``CANON_AUDIT.json`` / ``CANON_AUDIT.md`` / ``MQO_AUDIT.json`` /
-   ``MQO_AUDIT.md`` / ``DICT_AUDIT.json`` / ``DICT_AUDIT.md``)
+   ``MQO_AUDIT.md`` / ``DICT_AUDIT.json`` / ``DICT_AUDIT.md`` /
+   ``COST_LINT.json`` / ``COST_LINT.md``)
    and fail when a cited file is absent
    from the tree.  A citation whose line carries an explicit
    not-here-yet marker (``pending``, ``uncommitted``,
@@ -43,6 +44,7 @@ CITED_RE = re.compile(
     r"|\bCANON_AUDIT\.(?:json|md)\b"
     r"|\bMQO_AUDIT\.(?:json|md)\b"
     r"|\bDICT_AUDIT\.(?:json|md)\b"
+    r"|\bCOST_LINT\.(?:json|md)\b"
     r"|\bRUN_STATE\.json\b"
     r"|\bINGEST_DIFF\.json\b"
     r"|\bSLO\.json\b")

@@ -172,6 +172,15 @@ def bind_plan_params(plan: lp.Plan, binding) -> lp.Plan:
     return plan
 
 
+def _table_bytes(t: Table) -> int:
+    """Replicated footprint of a host build table through memplan's
+    row-width model (the same width the static analyzer estimates)."""
+    from ndstpu.engine import memplan
+    return memplan.row_bytes(
+        [t.column(nm).data.dtype.itemsize
+         for nm in t.column_names]) * int(t.num_rows)
+
+
 _SPINE_NODES = (lp.Scan, lp.Filter, lp.Project, lp.Join, lp.SubqueryAlias)
 # shardable key kinds and decomposable aggregates come from the shared
 # supported-op registry so the static analyzer (NDS3xx) cannot drift
@@ -243,12 +252,26 @@ class DistributedPlanExecutor:
                  broadcast_limit_rows: int = lowreg.SPMD_BROADCAST_LIMIT_ROWS,
                  dev_cache: Optional[dict] = None,
                  chunk_rows=None,
-                 prefetch_depth: Optional[int] = None):
+                 prefetch_depth: Optional[int] = None,
+                 cost_advisor="auto"):
         self.catalog = catalog
         self.mesh = mesh
         self.n_dev = int(mesh.devices.size)
         self.threshold = shard_threshold_rows
         self.broadcast_limit = broadcast_limit_rows
+        # exchange-placement advisor (analysis/cost.py): "auto" resolves
+        # to the cost model over the runtime device budget when
+        # NDSTPU_COST is on; None restores the fixed rows-only rule
+        if cost_advisor == "auto":
+            from ndstpu.analysis import cost as _cost
+            cost_advisor = _cost.default_advisor(broadcast_limit_rows) \
+                if _cost.enabled() else None
+        self.cost_advisor = cost_advisor
+        # per-join advisor decisions for this plan (query-span attr ->
+        # ledger extra); _order_safe: an aggregate spine is insensitive
+        # to row placement, a row spine's output order is not
+        self.cost_decisions: List[dict] = []
+        self._order_safe = False
         # out-of-core: facts above this row count stream through the
         # device shard-major — device d owns fact rows
         # [d*shard_rows, (d+1)*shard_rows) and streams only its shard's
@@ -304,9 +327,11 @@ class DistributedPlanExecutor:
             plan = bind_plan_params(plan, params)
         union = self._try_union_agg(plan)
         if union is not None:
+            self._annotate_decisions()
             return union
         offload = self._try_subquery_offload(plan)
         if offload is not None:
+            self._annotate_decisions()
             return offload
         scans = [n for n in plan.walk() if isinstance(n, lp.Scan)]
         if not scans:
@@ -325,6 +350,7 @@ class DistributedPlanExecutor:
             self._prepared = False
             self._tail = None
             self._has_win = False
+            self.cost_decisions = []
             try:
                 spine, top = self._split(plan)
                 result = self._run_spine_retrying(spine)
@@ -334,9 +360,21 @@ class DistributedPlanExecutor:
                 last = e
                 continue
             self._spine, self._top = spine, top
+            self._annotate_decisions()
             return self._finish(result)
         raise last or DistUnsupported("no sharded-size table in plan",
                                       code="NDS301")
+
+    def _annotate_decisions(self) -> None:
+        """Compact advisor trail on the query span (-> ledger extra
+        ``cost_decisions``): one ``kind:strategy`` token per spine
+        join, ``*`` marking a cost override of the structural rule."""
+        if not self.cost_decisions:
+            return
+        obs.annotate(cost_decisions=" ".join(
+            f"{d['kind']}:{d['strategy']}"
+            + ("*" if d["overrode"] else "")
+            for d in self.cost_decisions))
 
     def _try_subquery_offload(self, plan: lp.Plan) -> Optional[Table]:
         """q9 shape: the outer plan scans only sub-threshold tables (its
@@ -381,9 +419,11 @@ class DistributedPlanExecutor:
                 shard_threshold_rows=self.threshold,
                 broadcast_limit_rows=self.broadcast_limit,
                 dev_cache=self.dev_cache, chunk_rows=self.chunk_rows,
-                prefetch_depth=self.prefetch_depth)
+                prefetch_depth=self.prefetch_depth,
+                cost_advisor=self.cost_advisor)
             firsts.append(child.execute_plan(s.plan))  # DistUnsupported
             self.attempt_codes += child.attempt_codes  # propagates
+            self.cost_decisions += child.cost_decisions
             children.append((s, child))
         self._scalar_ctx = (plan, children)
         return self._scalar_finish(firsts)
@@ -426,6 +466,7 @@ class DistributedPlanExecutor:
             self._prepared = False
             self._tail = None
             self._has_win = False
+            self.cost_decisions = []
             try:
                 spine, top = self._split(plan)
                 if spine is not plan:
@@ -572,10 +613,12 @@ class DistributedPlanExecutor:
                 self.catalog, self.mesh, self.threshold,
                 self.broadcast_limit, self.dev_cache,
                 chunk_rows=self.chunk_rows,
-                prefetch_depth=self.prefetch_depth)
+                prefetch_depth=self.prefetch_depth,
+                cost_advisor=self.cost_advisor)
             try:
                 kc, lps = exe.collect_partials(bplan)
                 self.attempt_codes += exe.attempt_codes
+                self.cost_decisions += exe.cost_decisions
                 parts.append((kc, lps, list(exe._leaf_meta)))
                 sub_execs.append(exe)
                 any_dist = True
@@ -606,10 +649,12 @@ class DistributedPlanExecutor:
             self.catalog, self.mesh, self.threshold,
             self.broadcast_limit, self.dev_cache,
             chunk_rows=self.chunk_rows,
-            prefetch_depth=self.prefetch_depth)
+            prefetch_depth=self.prefetch_depth,
+            cost_advisor=self.cost_advisor)
         try:
             out = nxt.execute_plan(rest)
             self.attempt_codes += nxt.attempt_codes
+            self.cost_decisions += nxt.cost_decisions
             self._union_next = nxt
             return out
         except DistUnsupported:
@@ -1101,7 +1146,31 @@ class DistributedPlanExecutor:
                     if dup_max > 32:
                         raise DistUnsupported(
                             f"build key runs too long ({dup_max})")
-            if build.num_rows > self.broadcast_limit:
+            # exchange placement: the structural rule is rows-only; the
+            # cost advisor (analysis/cost.py, same choose_strategy the
+            # static NDS305/NDS601 analysis uses) may demote a
+            # byte-heavy under-row-limit build to the shuffle path —
+            # demote-only, and only on placement-order-insensitive
+            # (aggregate) spines, so results stay bit-identical to
+            # NDSTPU_COST=0
+            strategy = "shuffle" if build.num_rows > self.broadcast_limit \
+                else "broadcast"
+            if self.cost_advisor is not None:
+                d = self.cost_advisor.decide_join(
+                    build_rows=build.num_rows,
+                    build_bytes=_table_bytes(build), kind=kind,
+                    dup_max=dup_max, order_safe=self._order_safe)
+                obs.inc("engine.cost.decisions")
+                if d.overrode:
+                    obs.inc("engine.cost.overrides")
+                self.cost_decisions.append({
+                    "kind": kind, "strategy": d.strategy,
+                    "structural": d.structural,
+                    "build_rows": int(build.num_rows),
+                    "build_bytes": _table_bytes(build),
+                    "overrode": d.overrode, "reason": d.reason})
+                strategy = d.strategy
+            if strategy == "shuffle":
                 if dup_max and kind == "inner":
                     raise DistUnsupported(
                         "expanding inner join on a shuffle build side")
@@ -1184,7 +1253,8 @@ class DistributedPlanExecutor:
             self.catalog, self.mesh, self.threshold,
             self.broadcast_limit, self.dev_cache,
             chunk_rows=self.chunk_rows,
-            prefetch_depth=self.prefetch_depth)
+            prefetch_depth=self.prefetch_depth,
+            cost_advisor=self.cost_advisor)
         try:
             reduced = child.execute_plan(bplan)
         except (DistUnsupported, Unsupported) as e:
@@ -1194,8 +1264,19 @@ class DistributedPlanExecutor:
             self.attempt_codes += child.attempt_codes
             return None
         self.attempt_codes += child.attempt_codes
+        self.cost_decisions += child.cost_decisions
         self.build_reduced.append((p.kind, reduced.num_rows))
         obs.inc("engine.spmd.build_reduce")
+        if self.cost_advisor is not None:
+            obs.inc("engine.cost.decisions")
+            self.cost_decisions.append({
+                "kind": p.kind, "strategy": "build-reduce",
+                "structural": "build-reduce",
+                "build_rows": int(reduced.num_rows),
+                "build_bytes": _table_bytes(reduced),
+                "overrode": False,
+                "reason": "existence build reduced to distinct key "
+                          "tuples distributed"})
         new_keys = [(pe, ex.ColumnRef(f"__bk{i}"))
                     for i, (pe, _be) in enumerate(keys)]
         return reduced, new_keys
@@ -1263,6 +1344,11 @@ class DistributedPlanExecutor:
             self._dup_insensitive = agg is not None and all(
                 a.func in ("min", "max") or a.distinct
                 for a in self._agg_leaves(agg))
+            # an aggregate spine combines partials key-wise, so exchange
+            # placement cannot change the observable result; a row spine
+            # emits rows in placement order, so the cost advisor must
+            # not re-place its joins (bit-identical vs NDSTPU_COST=0)
+            self._order_safe = agg is not None
             self._row_head = row_head
             self._agg_refs = set()
             if agg is not None:
@@ -1491,9 +1577,19 @@ class DistributedPlanExecutor:
             max_depth = self.prefetch_depth \
                 if self.prefetch_depth is not None \
                 else memplan.DEFAULT_MAX_DEPTH
+            # cost-model working set: broadcast builds ride every device
+            # whole-query (shuffle builds are partitioned 1/n_dev and
+            # already inside COMPUTE_MULT slack) — carve their bytes out
+            # so fat replicated builds buy smaller chunks, not spills
+            resident = 0
+            if self.cost_advisor is not None:
+                resident = sum(
+                    _table_bytes(j.build) for j in self.joins.values()
+                    if isinstance(j, _BroadcastJoin))
             plan = memplan.plan_stream(n, bpr, self.n_dev,
                                        max_depth=max_depth,
-                                       dict_bytes=dict_bytes)
+                                       dict_bytes=dict_bytes,
+                                       resident_bytes=resident)
             obs.annotate(stream_plan=plan.describe())
             obs.set_gauge("engine.stream.chunk_rows",
                           plan.chunk_rows or 0)

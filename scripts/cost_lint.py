@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Corpus-wide static cost-model lint (NDS6xx).
+
+Sweeps every part of the power corpus through the static cost
+estimator (ndstpu/analysis/cost.py) — parse → plan → optimize over a
+ZERO-ROW schema catalog, so no warehouse, no data, no jax — and emits
+the per-part cost report: estimated output cardinality with its
+confidence band, predicted exchange placement per spine join (the
+same ``choose_strategy`` the runtime dplan advisor uses), predicted
+per-device working set, and predicted collective traffic.
+
+Emits:
+
+* ``COST_LINT.json`` / ``COST_LINT.md`` (repo root): per-part
+  estimates + placements plus NDS6xx diagnostics.  Deterministic (no
+  timestamps) so committed copies only change when the plans or the
+  model change.
+* NDS6xx diagnostics: NDS601 broadcast build over the replication
+  budget (cost model demotes to shuffle), NDS602 spill-risk working
+  set over the device budget, NDS603 exchange-heavy plan, NDS604
+  static-vs-observed misestimate (only with ``--calibrate``).  With
+  ``--baseline [PATH]``: exit nonzero iff a diagnostic is NOT in the
+  committed baseline (docs/cost_lint_baseline.json).
+* With ``--calibrate LEDGER``: join static row estimates against the
+  run ledger's observed output cardinalities (``extra.result_rows``,
+  stamped by harness/power.py), write per-query misestimate ratios
+  into COST_LINT.json, and emit NDS604 where the ratio exceeds the
+  threshold.
+* With ``--write-baseline``: regenerate the baseline from this sweep.
+
+Usage:
+    python scripts/cost_lint.py                      # artifacts only
+    python scripts/cost_lint.py --baseline           # CI gate
+    python scripts/cost_lint.py --write-baseline     # accept current set
+    python scripts/cost_lint.py --calibrate ledger.jsonl
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_BASELINE = REPO / "docs" / "cost_lint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", nargs="?", const=str(DEFAULT_BASELINE),
+                    default=None, metavar="PATH",
+                    help="gate against this baseline (default: "
+                         "docs/cost_lint_baseline.json); exit 1 on new "
+                         "diagnostics")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from this sweep")
+    ap.add_argument("--json", default=str(REPO / "COST_LINT.json"))
+    ap.add_argument("--md", default=str(REPO / "COST_LINT.md"))
+    ap.add_argument("--rngseed", default="07291122510",
+                    help="stream seed (pinned bench seed by default so "
+                         "the artifact is reproducible)")
+    ap.add_argument("--stream", type=int, default=0)
+    ap.add_argument("--scale_factor", type=float, default=1.0,
+                    help="scale factor for the base cardinalities")
+    ap.add_argument("--n_dev", type=int, default=8,
+                    help="mesh size assumed for the working-set model "
+                         "(the suite's virtual mesh by default)")
+    ap.add_argument("--calibrate", default=None, metavar="LEDGER",
+                    help="run-ledger JSONL with observed output "
+                         "cardinalities (extra.result_rows): writes "
+                         "per-query misestimate ratios and emits NDS604")
+    ap.add_argument("--sub_queries", default=None,
+                    help="comma-separated query-part subset (CI tiny run)")
+    return ap
+
+
+def sweep(args):
+    """part -> CostReport plus per-part analysis errors."""
+    from ndstpu import analysis
+    from ndstpu.analysis import cost
+    from ndstpu.engine.session import Session
+    from ndstpu.queries import streamgen
+
+    sess = Session(analysis.schema_catalog())
+    tables = analysis.schema_tables()
+    subset = set(args.sub_queries.split(",")) if args.sub_queries else None
+
+    reports, errors = {}, {}
+    for name, sql in streamgen.render_power_corpus(
+            rngseed=args.rngseed, stream=args.stream):
+        if subset is not None and name not in subset:
+            continue
+        try:
+            plan, _cols = sess.plan(sql)
+            reports[name] = cost.audit_cost(
+                plan, tables, query=name,
+                scale_factor=args.scale_factor, n_dev=args.n_dev)
+        except Exception as e:
+            errors[name] = f"{type(e).__name__}: {e}"
+    return reports, errors
+
+
+def run_lint(args) -> int:
+    from ndstpu.analysis import cost
+    from ndstpu.analysis import diagnostics as diag_mod
+
+    reports, errors = sweep(args)
+    diags = [d for r in reports.values() for d in r.diagnostics]
+
+    calibration_block = None
+    if args.calibrate:
+        observed = cost.observed_rows_from_ledger(args.calibrate)
+        estimated = {q: r.root for q, r in reports.items()}
+        calib = cost.Calibration.from_pairs(
+            {q: est.rows for q, est in estimated.items()}, observed)
+        diags += cost.misestimate_diags(estimated, observed)
+        calibration_block = {
+            "ledger": args.calibrate,
+            "queries_observed": len(calib.ratios),
+            "dispersion": round(calib.dispersion, 4),
+            "ratios": {q: round(r, 4)
+                       for q, r in sorted(calib.ratios.items())},
+        }
+
+    budget, budget_source = cost.cost_budget_bytes()
+    counts = {"broadcast": 0, "shuffle": 0, "build-reduce": 0}
+    for r in reports.values():
+        for k, v in r.placement_counts().items():
+            counts[k] += v
+    meta = {
+        "rngseed": args.rngseed,
+        "stream": args.stream,
+        "scale_factor": args.scale_factor,
+        "n_dev": args.n_dev,
+        "parts": len(reports),
+        "errors": errors,
+        "budget_bytes": budget,
+        "budget_source": budget_source,
+        "placements": counts,
+    }
+
+    out = {"meta": meta,
+           "queries": {q: r.as_dict()
+                       for q, r in sorted(reports.items())},
+           "diagnostics": [d.as_dict()
+                           for d in diag_mod.sort_diagnostics(diags)]}
+    if calibration_block is not None:
+        out["calibration"] = calibration_block
+    pathlib.Path(args.json).write_text(
+        json.dumps(out, indent=2, sort_keys=True) + "\n")
+
+    lines = ["# Static cost-model lint", ""]
+    for k, v in sorted(meta.items()):
+        lines.append(f"- **{k}**: {v}")
+    lines += [
+        "",
+        f"{meta['parts']} corpus parts estimated under a "
+        f"{budget} B device budget ({budget_source}): "
+        f"{counts['broadcast']} broadcast, {counts['shuffle']} "
+        f"shuffle, {counts['build-reduce']} build-reduce join "
+        f"placements predicted; {len(diags)} NDS6xx diagnostic(s).",
+        "",
+        "| query | est rows | band | working set B | exchange B "
+        "| bcast | shuf | reduce |",
+        "|---|---|---|---|---|---|---|---|"]
+    for q, r in sorted(reports.items()):
+        pc = r.placement_counts()
+        ws = r.working_set_bytes if r.working_set_bytes is not None \
+            else "?"
+        lines.append(
+            f"| {q} | {r.root.rows:.0f} "
+            f"| [{r.root.lo:g}, {r.root.hi:g}]x | {ws} "
+            f"| {r.exchange_bytes} | {pc['broadcast']} "
+            f"| {pc['shuffle']} | {pc['build-reduce']} |")
+    if calibration_block is not None:
+        lines += ["", "## Calibration", "",
+                  f"- ledger: `{calibration_block['ledger']}`",
+                  f"- queries observed: "
+                  f"{calibration_block['queries_observed']}",
+                  f"- ratio dispersion (geometric): "
+                  f"{calibration_block['dispersion']}"]
+        if calibration_block["ratios"]:
+            lines += ["", "| query | observed / estimated |", "|---|---|"]
+            for q, ratio in sorted(calibration_block["ratios"].items()):
+                lines.append(f"| {q} | {ratio} |")
+    if diags:
+        lines += ["", "## Diagnostics", ""]
+        for d in diag_mod.sort_diagnostics(diags):
+            lines.append(f"- `{d.query}` {d.code} [{d.path}]: "
+                         f"{d.message}")
+    pathlib.Path(args.md).write_text("\n".join(lines) + "\n")
+
+    print(f"cost-lint: {meta['parts']} parts, "
+          f"{sum(counts.values())} placements predicted "
+          f"({counts}), {len(diags)} diagnostic(s) -> {args.json}")
+    if errors:
+        print(f"cost-lint: {len(errors)} part(s) failed analysis: "
+              f"{sorted(errors)}", file=sys.stderr)
+
+    if args.write_baseline:
+        DEFAULT_BASELINE.write_text(diag_mod.baseline_dump(diags))
+        print(f"cost-lint: baseline rewritten -> {DEFAULT_BASELINE}")
+
+    if args.baseline is not None:
+        bpath = pathlib.Path(args.baseline)
+        if not bpath.exists():
+            print(f"cost-lint: baseline {bpath} missing "
+                  "(run --write-baseline)", file=sys.stderr)
+            return 2
+        accepted = diag_mod.baseline_load(bpath.read_text())
+        new = diag_mod.new_against_baseline(diags, accepted)
+        if new:
+            print(f"cost-lint: {len(new)} diagnostic(s) not in baseline:",
+                  file=sys.stderr)
+            for d in new:
+                print(f"  {d.query} {d.code} [{d.path}]: {d.message}",
+                      file=sys.stderr)
+            return 1
+        print(f"cost-lint: clean against baseline "
+              f"({len(accepted)} accepted)")
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_lint(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,7 @@ corpus queries, baseline gating, the plan_lint CLI, and the power-run
 
 import json
 import os
+import re
 import subprocess
 import sys
 
@@ -184,6 +185,51 @@ def test_golden_query61_diagnostics(sess, tables):
     res = analyze(sess, tables, corpus_part("query61"))
     assert sorted(codes(res)) == \
         ["NDS102", "NDS102", "NDS105", "NDS305", "NDS401"]
+
+
+# -- NDS305 cost-model placement -------------------------------------------
+
+_NDS305_RE = re.compile(
+    r"predicted exchange placement over (\w+): (\d+) broadcast "
+    r"join\(s\) \(~(\d+) est build B\), (\d+) shuffle \(all_to_all\) "
+    r"join\(s\), (\d+) build-reduce join\(s\)")
+
+
+def test_nds305_reports_placement_and_bytes(sess, tables):
+    sql = ("select d_year, count(*) as n from store_sales, date_dim "
+           "where ss_sold_date_sk = d_date_sk group by d_year")
+    res = analyze(sess, tables, sql)
+    msgs = [d.message for d in res.diagnostics if d.code == "NDS305"]
+    assert msgs, "spine query must carry the placement prediction"
+    m = _NDS305_RE.fullmatch(msgs[0])
+    assert m and m.group(1) == "store_sales"
+    assert int(m.group(2)) == 1          # date_dim build broadcasts
+    assert int(m.group(3)) > 0           # with a real byte estimate
+
+
+def test_nds305_agrees_with_cost_audit_on_corpus(sess, tables):
+    """Corpus agreement: the NDS305 placement mix (lowering's static
+    audit) must match the cost audit's per-join placements — both go
+    through the same choose_strategy the runtime dplan advisor uses,
+    so a divergence here means the static prediction and the runtime
+    decision rule have drifted apart."""
+    from ndstpu.analysis import cost
+
+    for part in ("query3", "query7", "query25", "query52", "query96"):
+        sql = corpus_part(part)
+        res = analyze(sess, tables, sql)
+        msgs = [d.message for d in res.diagnostics
+                if d.code == "NDS305"]
+        assert len(msgs) == 1, part
+        m = _NDS305_RE.fullmatch(msgs[0])
+        assert m, msgs[0]
+        plan, _cols = sess.plan(sql)
+        rep = cost.audit_cost(plan, tables, query=part,
+                              scale_factor=1.0, n_dev=8)
+        counts = rep.placement_counts()
+        assert (int(m.group(2)), int(m.group(4)), int(m.group(5))) == \
+            (counts["broadcast"], counts["shuffle"],
+             counts["build-reduce"]), part
 
 
 # -- diagnostics plumbing --------------------------------------------------

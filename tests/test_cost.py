@@ -1,0 +1,407 @@
+"""Static cost-model tests (ndstpu/analysis/cost.py): SF-scaled base
+cardinalities, selectivity heuristics, NDS601 budget demotion,
+ledger calibration, and the runtime differential — the dplan cost
+advisor must pick only among semantically equivalent strategies, so
+results are bit-identical (rows AND row order) with NDSTPU_COST=0."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ndstpu import analysis, obs
+from ndstpu.analysis import cost
+from ndstpu.analysis.spines import SF1_ROWS
+from ndstpu.engine import memplan, plan as lp
+from ndstpu.engine import expr as ex
+from ndstpu.engine.columnar import INT64, Column, Table
+from ndstpu.engine.session import Session
+from ndstpu.io.loader import Catalog
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return analysis.schema_tables()
+
+
+@pytest.fixture(scope="module")
+def sess():
+    return Session(analysis.schema_catalog())
+
+
+# -- base cardinalities -----------------------------------------------------
+
+
+def test_base_rows_scales_facts_not_dims(tables):
+    m1 = cost.CostModel(tables, scale_factor=1.0)
+    m10 = cost.CostModel(tables, scale_factor=10.0)
+    # facts (and the customer cluster) scale linearly with SF
+    assert m1.base_rows("store_sales") == SF1_ROWS["store_sales"]
+    assert m10.base_rows("store_sales") == \
+        pytest.approx(10 * SF1_ROWS["store_sales"])
+    # dimensions stay constant
+    assert m10.base_rows("date_dim") == SF1_ROWS["date_dim"]
+    assert m10.base_rows("not_a_table") is None
+
+
+def test_base_rows_row_counts_override(tables):
+    m = cost.CostModel(tables, scale_factor=100.0,
+                       row_counts={"store_sales": 4096})
+    assert m.base_rows("store_sales") == 4096.0     # override wins over SF
+    assert m.base_rows("item") == SF1_ROWS["item"]  # others unaffected
+
+
+# -- selectivity ------------------------------------------------------------
+
+
+def _scan(table):
+    return lp.Scan(table, table)
+
+
+def test_selectivity_and_is_monotone(tables):
+    m = cost.CostModel(tables)
+    scans = [_scan("store_sales"), _scan("date_dim")]
+    p1 = ex.BinOp("=", ex.ColumnRef("d_year"), ex.Literal(2000))
+    p2 = ex.BinOp(">", ex.ColumnRef("ss_quantity"), ex.Literal(50))
+    s1 = m.selectivity(p1, scans)
+    s2 = m.selectivity(p2, scans)
+    both = m.selectivity(ex.BinOp("and", p1, p2), scans)
+    assert 0.0 < both <= min(s1, s2)            # AND never keeps more
+    either = m.selectivity(ex.BinOp("or", p1, p2), scans)
+    assert max(s1, s2) <= either <= min(s1 + s2, 1.0)
+    # complement
+    sn = m.selectivity(ex.UnaryOp("not", p1), scans)
+    assert sn == pytest.approx(1.0 - s1)
+
+
+def test_selectivity_inlist_grows_with_values(tables):
+    m = cost.CostModel(tables)
+    scans = [_scan("date_dim")]
+    few = ex.InList(ex.ColumnRef("d_year"), (1999, 2000))
+    many = ex.InList(ex.ColumnRef("d_year"), tuple(range(1990, 2000)))
+    assert m.selectivity(few, scans) < m.selectivity(many, scans)
+    neg = ex.InList(ex.ColumnRef("d_year"), (1999, 2000), negated=True)
+    assert m.selectivity(neg, scans) == \
+        pytest.approx(1.0 - m.selectivity(few, scans))
+
+
+def test_filter_estimate_shrinks(sess, tables):
+    plan, _ = sess.plan(
+        "select ss_item_sk from store_sales where ss_quantity > 50")
+    m = cost.CostModel(tables, scale_factor=1.0)
+    est = m.estimate(plan)
+    assert 0 < est.rows < SF1_ROWS["store_sales"]
+
+
+def test_band_widens_with_depth_and_caps(sess, tables):
+    shallow, _ = sess.plan("select ss_item_sk from store_sales")
+    deep, _ = sess.plan(
+        "select d_year, count(*) as n from store_sales, date_dim, item "
+        "where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk "
+        "and ss_quantity > 50 and d_year = 2000 group by d_year")
+    m = cost.CostModel(tables, scale_factor=1.0)
+    e_shallow = m.estimate_query(shallow)
+    e_deep = m.estimate_query(deep)
+    assert e_shallow.hi < e_deep.hi
+    assert e_deep.hi <= 2.0 ** cost.MAX_BAND_STEPS
+    assert e_deep.lo == pytest.approx(1.0 / e_deep.hi)
+
+
+# -- NDS601: broadcast build over the replication budget --------------------
+
+
+def test_nds601_wide_build_demoted(sess, tables):
+    sql = ("select d_year, count(*) as n from store_sales, date_dim "
+           "where ss_sold_date_sk = d_date_sk group by d_year")
+    plan, _ = sess.plan(sql)
+    # generous budget: dimension build broadcasts, no diagnostics
+    r_ok = cost.audit_cost(plan, tables, query="q", scale_factor=1.0,
+                           budget_bytes=1 << 30, n_dev=8)
+    assert r_ok.placement_counts()["broadcast"] >= 1
+    assert not [d for d in r_ok.diagnostics if d.code == "NDS601"]
+    # starved budget: same build is over the replication fraction ->
+    # NDS601 + demotion to the shuffle path
+    r_tight = cost.audit_cost(plan, tables, query="q", scale_factor=1.0,
+                              budget_bytes=100_000, n_dev=8)
+    assert r_tight.placement_counts()["shuffle"] >= 1
+    d601 = [d for d in r_tight.diagnostics if d.code == "NDS601"]
+    assert d601 and "replication budget" in d601[0].message
+    demoted = [p for p in r_tight.placements
+               if p.decision.strategy == "shuffle"
+               and p.decision.structural == "broadcast"]
+    assert demoted and demoted[0].decision.overrode
+
+
+def test_nds602_spill_risk_on_starved_budget(sess, tables):
+    plan, _ = sess.plan(
+        "select ss_item_sk, ss_quantity from store_sales")
+    r = cost.audit_cost(plan, tables, query="q", scale_factor=1.0,
+                        budget_bytes=50_000, n_dev=2)
+    assert any(d.code == "NDS602" for d in r.diagnostics)
+    assert r.working_set_bytes is not None
+    assert r.working_set_bytes > 50_000
+
+
+def test_nds6xx_registered():
+    from ndstpu.analysis import diagnostics
+    assert diagnostics.CODES["NDS601"][0] == "warning"
+    assert diagnostics.CODES["NDS602"][0] == "warning"
+    assert diagnostics.CODES["NDS603"][0] == "info"
+    assert diagnostics.CODES["NDS604"][0] == "info"
+
+
+# -- calibration ------------------------------------------------------------
+
+
+def _fake_ledger(path, rows_by_query):
+    with open(path, "w") as f:
+        for q, n in rows_by_query.items():
+            f.write(json.dumps({
+                "query": q, "stream": 0, "status": "ok",
+                "extra": {"result_rows": n}}) + "\n")
+    return str(path)
+
+
+def test_calibration_recenters_estimate(sess, tables, tmp_path):
+    sql = "select d_year, count(*) as n from date_dim group by d_year"
+    plan, _ = sess.plan(sql)
+    raw = cost.CostModel(tables, query="qx").estimate_query(plan)
+    ledger = _fake_ledger(tmp_path / "ledger.jsonl",
+                          {"qx": raw.rows * 3.0, "qy": 10})
+    observed = cost.observed_rows_from_ledger(ledger)
+    assert observed["qx"] == pytest.approx(raw.rows * 3.0)
+    calib = cost.Calibration.from_ledger(ledger, {"qx": raw.rows})
+    assert calib.ratios["qx"] == pytest.approx(3.0)
+    m = cost.CostModel(tables, query="qx", calibration=calib)
+    est = m.estimate_query(plan)
+    # recentered on the observed ratio, band from the calibration
+    # dispersion (replaces the per-step doubling band)
+    assert est.rows == pytest.approx(raw.rows * 3.0)
+    assert est.hi == pytest.approx(calib.dispersion)
+    assert est.lo == pytest.approx(1.0 / calib.dispersion)
+    # uncalibrated query keeps the heuristic band
+    other = cost.CostModel(tables, query="unseen",
+                           calibration=calib).estimate_query(plan)
+    assert other.rows == pytest.approx(raw.rows)
+
+
+def test_misestimate_nds604(tmp_path):
+    estimated = {"qa": cost.CostEstimate(rows=100.0),
+                 "qb": cost.CostEstimate(rows=100.0),
+                 "qc": cost.CostEstimate(rows=100.0)}
+    observed = {"qa": 100.0 * (cost.MISESTIMATE_RATIO + 1),  # over
+                "qb": 100.0 / (cost.MISESTIMATE_RATIO + 1),  # under
+                "qc": 120.0}                                 # in band
+    diags = cost.misestimate_diags(estimated, observed)
+    assert sorted(d.query for d in diags) == ["qa", "qb"]
+    assert all(d.code == "NDS604" for d in diags)
+
+
+def test_cost_budget_sources(monkeypatch):
+    monkeypatch.setenv("NDSTPU_COST_BUDGET_BYTES", "777")
+    assert cost.cost_budget_bytes() == (777, "env")
+    monkeypatch.delenv("NDSTPU_COST_BUDGET_BYTES")
+    monkeypatch.setenv("NDSTPU_HBM_BYTES", "100000")
+    assert cost.cost_budget_bytes() == \
+        (int(100000 * memplan.SAFETY), "hbm")
+    monkeypatch.delenv("NDSTPU_HBM_BYTES")
+    budget, src = cost.cost_budget_bytes()
+    assert budget > 0 and src == "default"
+
+
+def test_memplan_resident_carveout_shrinks_chunks():
+    """Broadcast-build bytes predicted resident by the advisor come out
+    of the streaming budget: same fact, smaller (or equal) chunks."""
+    base = memplan.plan_stream(1_000_000, 100, 2, budget_bytes=8 << 20)
+    carved = memplan.plan_stream(1_000_000, 100, 2, budget_bytes=8 << 20,
+                                 resident_bytes=2 << 20)
+    assert base.chunk_rows is not None and carved.chunk_rows is not None
+    assert carved.chunk_rows < base.chunk_rows
+    # a resident footprint never flips a resident-fit plan to chunked
+    # unless it actually eats the headroom
+    tiny = memplan.plan_stream(1000, 100, 2, budget_bytes=2 << 30,
+                               resident_bytes=1 << 20)
+    assert tiny.chunk_rows is None
+
+
+# -- choose_strategy / advisor ----------------------------------------------
+
+
+def test_choose_strategy_demote_only():
+    kw = dict(broadcast_limit_rows=1000, budget_bytes=100_000)
+    # small build under both limits: broadcast, no override
+    d = cost.choose_strategy(10, 500, **kw)
+    assert d.strategy == "broadcast" and not d.overrode
+    # byte-heavy build under the row limit: demoted (the override)
+    d = cost.choose_strategy(10, 90_000, **kw)
+    assert (d.strategy, d.structural) == ("shuffle", "broadcast")
+    assert d.overrode
+    # over the row limit: structural shuffle either way — the model
+    # never promotes shuffle -> broadcast (forced-shuffle tests keep
+    # their meaning)
+    d = cost.choose_strategy(5000, 500, **kw)
+    assert (d.strategy, d.structural) == ("shuffle", "shuffle")
+    # reducible existence build wins outright
+    d = cost.choose_strategy(5000, 500, reducible=True, **kw)
+    assert d.strategy == "build-reduce"
+
+
+def test_advisor_suppresses_unsafe_overrides():
+    adv = cost.CostAdvisor(broadcast_limit_rows=1000,
+                           budget_bytes=100_000)
+    base = dict(build_rows=10, build_bytes=90_000, kind="inner")
+    # row-order-sensitive spine: the demotion is suppressed
+    d = adv.decide_join(dup_max=0, order_safe=False, **base)
+    assert d.strategy == "broadcast" and not d.overrode
+    # expanding inner join (dup_max > 0 = non-unique build keys)
+    # cannot take the shuffle path
+    d = adv.decide_join(dup_max=3, order_safe=True, **base)
+    assert d.strategy == "broadcast" and not d.overrode
+    # aggregate spine + unique build keys: demotion goes through
+    d = adv.decide_join(dup_max=0, order_safe=True, **base)
+    assert (d.strategy, d.structural) == ("shuffle", "broadcast")
+
+
+# -- runtime differential: cost-driven dplan vs NDSTPU_COST=0 ---------------
+
+N_FACT = 4096
+N_DIM = 512
+
+
+def _wide_catalog():
+    """fact (sharded) joining a byte-heavy dim: 512 rows x 10 int64
+    cols ~ 41 KB build — under any row limit, over a starved byte
+    budget's replication fraction."""
+    rng = np.random.RandomState(7)
+    fact = Table({
+        "f_key": Column(rng.randint(0, N_DIM, N_FACT).astype(np.int64),
+                        INT64),
+        "f_qty": Column(rng.randint(0, 100, N_FACT).astype(np.int64),
+                        INT64),
+    })
+    cols = {"d_key": Column(np.arange(N_DIM, dtype=np.int64), INT64),
+            "d_grp": Column((np.arange(N_DIM, dtype=np.int64) % 16),
+                            INT64)}
+    for i in range(8):   # pad the build side wide
+        cols[f"d_pad{i}"] = Column(
+            rng.randint(0, 1000, N_DIM).astype(np.int64), INT64)
+    dim = Table(cols)
+    cat = Catalog()
+    cat.register("fact", fact)
+    cat.register("dim", dim)
+    return cat
+
+# every pad column is aggregated so the optimizer cannot prune the
+# build side narrow — the runtime build really is ~41 KB; all-integer
+# aggregates keep the differential exact (no float reassociation)
+Q_DIFF = ("select d_grp, count(*) as n, sum(f_qty) as s, "
+          "min(f_qty) as lo, max(f_qty) as hi, "
+          + ", ".join(f"sum(d_pad{i}) as p{i}" for i in range(8))
+          + " from fact, dim where f_key = d_key "
+          "group by d_grp order by d_grp")
+
+
+def _table_rows(t):
+    return list(map(str, t.to_rows()))
+
+
+def test_dplan_cost_demotion_recorded():
+    """Direct executor: the starved advisor demotes the wide build to
+    the shuffle path, records the decision, and still matches the
+    oracle exactly."""
+    from ndstpu.engine import physical
+    from ndstpu.parallel import dplan, mesh as pmesh
+
+    cat = _wide_catalog()
+    plan, _ = Session(cat, backend="cpu").plan(Q_DIFF)
+    oracle = _table_rows(physical.execute(plan, cat))
+
+    adv = cost.CostAdvisor(broadcast_limit_rows=50_000,
+                           budget_bytes=50_000)
+    before = obs.counters_snapshot()
+    exe = dplan.DistributedPlanExecutor(
+        cat, pmesh.make_mesh(8), shard_threshold_rows=1000,
+        broadcast_limit_rows=50_000, cost_advisor=adv)
+    got = _table_rows(exe.execute_plan(plan))
+    assert got == oracle
+    assert any(d["overrode"] and d["strategy"] == "shuffle"
+               for d in exe.cost_decisions)
+    d = obs.counter_delta(before)
+    assert d.get("engine.cost.decisions", 0) >= 1
+    assert d.get("engine.cost.overrides", 0) >= 1
+
+    # control: advisor off = structural rule = broadcast, same rows
+    exe0 = dplan.DistributedPlanExecutor(
+        cat, pmesh.make_mesh(8), shard_threshold_rows=1000,
+        broadcast_limit_rows=50_000, cost_advisor=None)
+    got0 = _table_rows(exe0.execute_plan(plan))
+    assert got0 == oracle == got        # bit-identical, order included
+    assert exe0.cost_decisions == []
+
+
+@pytest.mark.parametrize("backend", ["tpu", "tpu-spmd"])
+def test_session_cost_differential_bit_identical(backend, monkeypatch):
+    """Session path on a starved device budget: NDSTPU_COST on vs off
+    must be bit-identical — rows AND row order (the aggregate uses
+    exact integer arithmetic, so any divergence is a placement bug,
+    not float reassociation)."""
+    monkeypatch.setenv("NDSTPU_HBM_BYTES", "100000")
+    cat = _wide_catalog()
+
+    monkeypatch.setenv("NDSTPU_COST", "0")
+    assert not cost.enabled()
+    off = Session(cat, backend=backend, spmd_threshold=1000).sql(Q_DIFF)
+
+    monkeypatch.setenv("NDSTPU_COST", "1")
+    assert cost.enabled()
+    sess_on = Session(cat, backend=backend, spmd_threshold=1000)
+    on = sess_on.sql(Q_DIFF)
+
+    assert _table_rows(on) == _table_rows(off)
+    if backend == "tpu-spmd":
+        # the starved budget really did engage the advisor
+        assert sess_on._cost_advisor() is not None
+        assert sess_on._cost_advisor().budget_bytes == \
+            int(100000 * memplan.SAFETY)
+
+
+def test_session_cost_kill_switch_disables_advisor(monkeypatch):
+    monkeypatch.setenv("NDSTPU_COST", "0")
+    sess = Session(_wide_catalog(), backend="tpu-spmd")
+    assert sess._cost_advisor() is None
+
+
+# -- static vs runtime agreement --------------------------------------------
+
+
+def test_static_placement_agrees_with_runtime():
+    """The lint-side choose_strategy over estimated rows/bytes and the
+    runtime advisor over actual rows/bytes agree on the synthetic
+    catalog when the static model is handed the true row counts."""
+    from ndstpu.parallel import dplan, mesh as pmesh
+
+    from ndstpu import schema as nds_schema
+
+    cat = _wide_catalog()
+    # audit_cost wants TableSchemas; derive them from the live tables
+    tables = {
+        name: nds_schema.TableSchema(name, tuple(
+            nds_schema.ColumnSpec(cn, t.column(cn).ctype)
+            for cn in t.column_names))
+        for name, t in cat.tables.items()}
+    plan, _ = Session(cat, backend="cpu").plan(Q_DIFF)
+    counts = {n: t.num_rows for n, t in cat.tables.items()}
+    rep = cost.audit_cost(
+        plan, tables, query="qdiff", budget_bytes=50_000,
+        n_dev=8, broadcast_limit_rows=50_000,
+        shard_threshold_rows=1000, row_counts=counts)
+    static = [p.decision.strategy for p in rep.placements]
+
+    adv = cost.CostAdvisor(broadcast_limit_rows=50_000,
+                           budget_bytes=50_000)
+    exe = dplan.DistributedPlanExecutor(
+        cat, pmesh.make_mesh(8), shard_threshold_rows=1000,
+        broadcast_limit_rows=50_000, cost_advisor=adv)
+    exe.execute_plan(plan)
+    runtime = [d["strategy"] for d in exe.cost_decisions]
+    assert static == runtime == ["shuffle"]

@@ -54,6 +54,15 @@ def test_canon_audit_root_citations_checked(tmp_path):
     assert artifact_lint.lint_text(text, str(tmp_path)) == []
 
 
+def test_cost_lint_root_citations_checked(tmp_path):
+    text = "cost sweep in `COST_LINT.json` and `COST_LINT.md`\n"
+    findings = artifact_lint.lint_text(text, str(tmp_path))
+    assert len(findings) == 2
+    (tmp_path / "COST_LINT.json").write_text("{}")
+    (tmp_path / "COST_LINT.md").write_text("# cost\n")
+    assert artifact_lint.lint_text(text, str(tmp_path)) == []
+
+
 def test_config_mismatch_flagged_unless_stale(tmp_path):
     docs = tmp_path / "docs"
     docs.mkdir()
