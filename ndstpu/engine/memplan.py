@@ -46,6 +46,17 @@ COMPUTE_MULT = 6
 #: smallest chunk worth compiling a streaming program for
 MIN_CHUNK_ROWS = 4096
 
+#: modeled device working set of one admitted serve query when the
+#: caller has nothing better (override: NDSTPU_SERVE_QUERY_BYTES) —
+#: sized for the tiny-corpus serve tier; real fleets pass the fact's
+#: schema_row_bytes * chunk estimate instead
+DEFAULT_QUERY_WORKING_SET_BYTES = 64 << 20
+
+#: admission depth clamps: at least one query must always be
+#: admittable, and no memory model justifies queueing thousands
+ADMISSION_MIN_DEPTH = 1
+ADMISSION_MAX_DEPTH = 256
+
 #: deepest staging ring the planner will ask for
 DEFAULT_MAX_DEPTH = 2
 
@@ -118,6 +129,38 @@ def device_budget_bytes(device=None) -> Tuple[int, str]:
         if free > 0:
             return free, "memory_stats"
     return DEFAULT_BUDGET_BYTES, "default"
+
+
+def admission_budget(bytes_per_query: Optional[int] = None,
+                     budget_bytes: Optional[int] = None,
+                     budget_source: str = "caller",
+                     min_depth: int = ADMISSION_MIN_DEPTH,
+                     max_depth: int = ADMISSION_MAX_DEPTH) -> dict:
+    """Admission budget query for the serve layer: how many
+    concurrently-admitted queries the device-memory model supports.
+
+    The same ``SAFETY``-discounted per-device budget that sizes
+    streaming chunks is divided by the modeled per-query working set
+    (``bytes_per_query``; default :data:`DEFAULT_QUERY_WORKING_SET_BYTES`
+    or the ``NDSTPU_SERVE_QUERY_BYTES`` override) and clamped to
+    ``[min_depth, max_depth]``.  A clamped ``NDSTPU_HBM_BYTES`` thus
+    shrinks the serve queue directly: a memory-starved replica sheds
+    (``Overloaded``) instead of queueing work it cannot hold.
+    """
+    if budget_bytes is None:
+        budget_bytes, budget_source = device_budget_bytes()
+    if bytes_per_query is None:
+        env = os.environ.get("NDSTPU_SERVE_QUERY_BYTES")
+        bytes_per_query = (max(int(env), 1) if env
+                           else DEFAULT_QUERY_WORKING_SET_BYTES)
+    usable = max(int(budget_bytes * SAFETY), 1)
+    depth = usable // max(int(bytes_per_query), 1)
+    depth = max(int(min_depth), min(int(depth), int(max_depth)))
+    return {"depth": depth,
+            "budget_bytes": int(budget_bytes),
+            "budget_source": budget_source,
+            "bytes_per_query": int(bytes_per_query),
+            "usable_bytes": usable}
 
 
 def _pow2_floor(n: int) -> int:

@@ -20,6 +20,11 @@ site                   probe location
 ``serve.accept``       query-server connection accept loop (serve/server)
 ``serve.dispatch``     query-server request dispatch, pre-retry — faults
                        here are client-visible and exercise client retry
+``serve.replica.crash``whole-replica process death mid-dispatch
+                       (os._exit) — the fleet supervisor restarts it,
+                       clients fail over to a sibling (serve/fleet)
+``fleet.probe``        fleet supervisor health probe — exercises the
+                       consecutive-failure threshold before a restart
 =====================  ====================================================
 
 A spec is a comma-separated rule list::
@@ -53,7 +58,8 @@ from ndstpu import obs
 SITES = ("plan", "compile", "execute", "io.write", "io.read",
          "io.prefetch", "exchange.collective", "stream.worker",
          "phase.subprocess", "ingest.commit", "ingest.apply",
-         "serve.accept", "serve.dispatch")
+         "serve.accept", "serve.dispatch", "serve.replica.crash",
+         "fleet.probe")
 
 KINDS = ("transient", "permanent", "hang")
 

@@ -35,6 +35,10 @@ TRANSIENT_TYPE_NAMES = frozenset({
     "ConnectionError",
     "ConnectionResetError",
     "ConnectionAbortedError",
+    # refused MUST stay transient: during a fleet replica restart a
+    # connect races the new incarnation's bind, and a client that
+    # treats refusal as permanent abandons a server that is seconds
+    # from ready (serve/client.py failover; tests/test_serve.py)
     "ConnectionRefusedError",
     "BrokenPipeError",
     "InterruptedError",

@@ -16,12 +16,15 @@ The reference fans out concurrent spark-submit processes with
   ndstpu.harness.scheduler.  Same `--concurrent` slot semantics
   (in-process gate), same overlap-report format, same time-log
   contract.
-* ``--mode serve --serve_socket PATH``: the streams become N client
+* ``--mode serve --serve_socket SPEC``: the streams become N client
   connections to a RUNNING query server (ndstpu/serve) — the spec's
   throughput phase doubling as a server load test.  Admission slots,
   tenant budgets, and shedding are the server's; each stream runs as
   its own tenant and the shared overlap-report format records what the
-  server let overlap.
+  server let overlap.  SPEC may be one endpoint (unix path or
+  ``tcp:HOST:PORT``) or a comma-separated FLEET of them — clients then
+  fail over between replicas, and the overlap report gains per-stream
+  ``failovers`` plus per-replica health attribution.
 
     python -m ndstpu.harness.throughput 1,2,3 --concurrent 2 -- \\
         python -m ndstpu.harness.power ./query_{}.sql ./wh ./time_{}.csv
@@ -235,7 +238,14 @@ def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
     writing happen inside the server.  Each stream is one client
     connection (= one server-side scheduler stream) under its own
     tenant; queries go up serially per stream like a power run, and the
-    server decides what overlaps."""
+    server decides what overlaps.
+
+    ``serve_socket`` may be a **fleet spec** — a comma-separated
+    endpoint list (serve/transport.py grammar) such as a
+    FleetSupervisor's ``endpoints_spec()``.  Each stream client then
+    fails over between replicas on connection faults and sheds; the
+    overlap report records per-stream ``failovers``/``endpoint`` and
+    per-replica health attribution under ``extra.replica_health``."""
     import threading
 
     from ndstpu.harness import power, scheduler
@@ -255,7 +265,12 @@ def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
             qd = power.get_query_subset(qd, ns.sub_queries.split(","))
         stem = os.path.splitext(
             os.path.basename(ns.query_stream_file))[0]
-        cli = ServeClient(serve_socket, tenant=f"stream-{sid}")
+        # fleet specs get a larger attempt budget: under depth-1
+        # backpressure every replica can shed for a full service
+        # time, and the bench must ride it out rather than fail
+        n_eps = len(str(serve_socket).split(","))
+        cli = ServeClient(serve_socket, tenant=f"stream-{sid}",
+                          retries=8 if n_eps == 1 else 8 + 4 * n_eps)
         start = time.time()
         code = executed = failures = skipped = 0
         obs.inc("harness.throughput.streams_launched")
@@ -305,6 +320,8 @@ def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
                 "failures": failures,
                 "skipped": skipped,
                 "client_retries": cli.retried,
+                "failovers": cli.failovers,
+                "endpoint": cli.endpoint.spec,
             })
 
     threads = [threading.Thread(target=worker, args=(sid,),
@@ -316,6 +333,23 @@ def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
     for th in threads:
         th.join()
     rc = 1 if any(r["returncode"] for r in records) else 0
+    # per-replica attribution: each endpoint answers its OWN health
+    # doc (counters are per-process), so a fleet run shows how load
+    # and sheds distributed across replicas
+    replica_health = {}
+    from ndstpu.serve import transport
+    endpoints = transport.parse_endpoints(serve_socket)
+    if len(endpoints) > 1:
+        for ep in endpoints:
+            one = ServeClient(ep.spec, retries=0,
+                              connect_timeout_s=2.0)
+            try:
+                replica_health[ep.spec] = one.health()
+            except Exception as e:  # noqa: BLE001 — evidence only
+                replica_health[ep.spec] = {"alive": False,
+                                           "error": str(e)}
+            finally:
+                one.close()
     # overlap evidence: stream walls from the client side; the device-
     # level peak is whatever the server's admission gate enforced,
     # reported via its health doc
@@ -324,6 +358,9 @@ def run_streams_serve(stream_ids: List[str], cmd_template: List[str],
         budget_s, mode="serve",
         extra={"serve_socket": serve_socket,
                "server_health": health or None,
+               "replica_health": replica_health or None,
+               "failovers_total": sum(r.get("failovers", 0)
+                                      for r in records),
                "total_elapse_s": round(time.time() - t0, 3)})
     return rc
 
@@ -371,8 +408,9 @@ def main(argv: List[str]) -> int:
         print(err, file=sys.stderr)
         return 2
     if mode == "serve" and not serve_socket:
-        print("--mode serve requires --serve_socket PATH "
-              "(a running ndstpu-serve server)", file=sys.stderr)
+        print("--mode serve requires --serve_socket SPEC "
+              "(a running ndstpu-serve server or comma-separated "
+              "fleet endpoints)", file=sys.stderr)
         return 2
     if budget_s is None and os.environ.get("NDSTPU_PHASE_BUDGET_S"):
         try:
@@ -387,7 +425,7 @@ def main(argv: List[str]) -> int:
         print("usage: throughput <id,id,...> [--concurrent N] "
               "[--budget_s S] [--overlap_report PATH] "
               "[--mode process|inproc|serve] "
-              "[--serve_socket PATH] -- "
+              "[--serve_socket SPEC[,SPEC...]] -- "
               "<command with {} placeholders>", file=sys.stderr)
         return 2
     stream_ids = [s for s in ids_arg[0].split(",") if s]

@@ -47,15 +47,18 @@ CITED_RE = re.compile(
     r"|\bCOST_LINT\.(?:json|md)\b"
     r"|\bRUN_STATE\.json\b"
     r"|\bINGEST_DIFF\.json\b"
-    r"|\bSLO\.json\b")
+    r"|\bSLO\.json\b"
+    r"|\bFLEET_HEALTH\.json\b")
 
 EXEMPT_MARKERS = ("pending", "uncommitted", "not committed")
 
 # recognized per-run journals/artifacts: docs cite these by name (they
-# define the resume/differential/SLO contracts, docs/ROBUSTNESS.md and
-# docs/OBSERVABILITY.md) but every run writes its own next to its
-# artifacts — there is never a committed copy to point at
-RUNTIME_ARTIFACTS = ("RUN_STATE.json", "INGEST_DIFF.json", "SLO.json")
+# define the resume/differential/SLO/fleet-health contracts,
+# docs/ROBUSTNESS.md and docs/OBSERVABILITY.md) but every run writes
+# its own next to its artifacts — there is never a committed copy to
+# point at
+RUNTIME_ARTIFACTS = ("RUN_STATE.json", "INGEST_DIFF.json", "SLO.json",
+                     "FLEET_HEALTH.json")
 
 _GROUPBY_DEFAULT_RE = re.compile(
     r'^GROUPBY_DEFAULT\s*=\s*["\'](\w+)["\']', re.MULTILINE)

@@ -12,7 +12,14 @@ this whole layer to Spark's scheduler backpressure):
   with a ``retry_after_s`` hint); a request whose ``deadline_s`` the
   projected queue wait already busts is shed as :class:`Rejected`
   ("deadline") — running it would waste device time on an answer the
-  client will no longer accept.
+  client will no longer accept.  The per-item wait estimate behind
+  both hints is an **EWMA of observed service walls**
+  (:meth:`AdmissionQueue.observe`), seeded by ``est_wait_s`` until the
+  first completion — a slow corpus pushes clients off proportionally
+  harder than a fast one, and the hint decays as the server speeds
+  back up.  Depth itself can come from the memplan device-memory
+  model (``engine/memplan.py:admission_budget``) instead of the
+  static 64 when the server is configured with ``queue_depth=auto``.
 * :class:`CircuitBreaker` — per canonical plan fingerprint, tripped by
   the PR 5 :class:`~ndstpu.faults.Quarantine` poison list: once a plan
   shape is quarantined the breaker fast-fails further requests for it
@@ -97,31 +104,65 @@ class TenantBudgets:
 
 
 class AdmissionQueue:
-    """Bounded admitted-but-unfinished request count + deadline shed."""
+    """Bounded admitted-but-unfinished request count + deadline shed.
+
+    ``est_wait_s`` is only the cold-start seed: every completed
+    request's wall feeds :meth:`observe`, and the live estimate is an
+    exponentially-weighted moving average (``ewma_alpha`` weight on
+    the newest wall).  ``retry_after_s`` hints and deadline sheds both
+    read the EWMA, so backoff tracks what the server is *actually*
+    doing right now."""
 
     def __init__(self, depth: int = 64,
                  est_wait_s: float = 0.25,
+                 ewma_alpha: float = 0.2,
                  clock: Callable[[], float] = time.monotonic):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], "
+                             f"got {ewma_alpha}")
         self.depth = depth
-        self.est_wait_s = est_wait_s  # projected wait per queued item
+        self.seed_wait_s = float(est_wait_s)  # pre-observation seed
+        self.ewma_alpha = float(ewma_alpha)
+        self._ewma_s: Optional[float] = None
+        self.observed = 0                     # walls folded into EWMA
         self._clock = clock
         self._lock = threading.Lock()
         self._admitted = 0
         self.peak = 0
+
+    @property
+    def est_wait_s(self) -> float:
+        """Projected wait per queued item: the service-wall EWMA once
+        anything has completed, the static seed before that."""
+        ewma = self._ewma_s
+        return self.seed_wait_s if ewma is None else ewma
+
+    def observe(self, wall_s: float) -> None:
+        """Fold one completed request's service wall into the EWMA."""
+        wall_s = max(float(wall_s), 0.0)
+        with self._lock:
+            if self._ewma_s is None:
+                self._ewma_s = wall_s
+            else:
+                a = self.ewma_alpha
+                self._ewma_s = a * wall_s + (1.0 - a) * self._ewma_s
+            self.observed += 1
 
     def admit(self, deadline_s: Optional[float] = None) -> None:
         """Admit or raise.  ``deadline_s`` is the client's remaining
         deadline for this request; a projected queue wait beyond it
         sheds the request NOW rather than serving a dead answer."""
         with self._lock:
+            est = (self.seed_wait_s if self._ewma_s is None
+                   else self._ewma_s)
             if self._admitted >= self.depth:
                 raise Overloaded(
                     f"admission queue full ({self._admitted}/"
-                    f"{self.depth})",
-                    retry_after_s=max(self.est_wait_s, 0.05))
-            projected = self._admitted * self.est_wait_s
+                    f"{self.depth}; est {est:.3f}s/query)",
+                    retry_after_s=max(est, 0.05))
+            projected = self._admitted * est
             if deadline_s is not None and projected > deadline_s:
                 raise Rejected(
                     f"projected queue wait {projected:.2f}s exceeds "
@@ -139,6 +180,15 @@ class AdmissionQueue:
     def admitted(self) -> int:
         with self._lock:
             return self._admitted
+
+    def snapshot(self) -> Dict[str, object]:
+        """Health/probe view of the queue's live state."""
+        with self._lock:
+            est = (self.seed_wait_s if self._ewma_s is None
+                   else self._ewma_s)
+            return {"depth": self.depth, "admitted": self._admitted,
+                    "peak": self.peak, "est_wait_s": round(est, 6),
+                    "observed": self.observed}
 
 
 class CircuitBreaker:

@@ -15,8 +15,21 @@ Requests are JSON objects with an ``op``:
     it rows materialize server-side and only the row count returns.
 ``ping`` / ``health`` / ``ready`` / ``stats``
     liveness, full health doc, readiness flag, obs counter snapshot.
+``probe``
+    ``{"op": "probe", "id": str}`` — the fleet supervisor's
+    liveness/readiness verb.  Answered at all times once the listener
+    is bound (``bind_early`` servers answer it **before** readiness),
+    returning ``{"probe": {"alive", "ready", "draining", "pid",
+    "replica_id", "endpoints", "uptime_s", "aot", ...}}``.  Readiness
+    flips only after warm-restart replay and the optional
+    ``--aot_corpus`` full-corpus precompile complete, so a supervisor
+    routing on ``ready`` never sends traffic to a cold replica.
 ``drain``
     begin graceful drain (lifecycle.py); responds before draining.
+
+Both transports (AF_UNIX and TCP, serve/transport.py) carry these
+frames unchanged — parity is byte-level, and ``MAX_FRAME_BYTES`` +
+per-connection read timeouts bound what one peer can pin.
 
 Responses carry ``status``: ``ok`` | ``error`` (+``taxonomy``,
 ``attempts``) | ``overloaded`` (+``retry_after_s``) | ``rejected``

@@ -25,6 +25,14 @@ Crash safety: every successful request journals its SQL + canonical
 key (lifecycle.ServeJournal) and compile records persist incrementally
 (``Session.compiled_count`` delta -> ``save_compiled``), so a SIGKILL
 loses nothing a warm restart needs.  SIGTERM runs the graceful drain.
+
+Fleet mode (serve/fleet.py) layers on top without changing the single
+server: ``bind_early`` brings the listener(s) up before warmth so the
+supervisor's ``probe`` verb can watch readiness flip, ``tcp`` adds a
+TCP listener beside AF_UNIX (serve/transport.py), ``aot_corpus``
+precompiles a full query corpus before readiness, ``replica_id`` tags
+probe/health docs, and ``queue_depth=None`` derives admission depth
+from the memplan device-memory model (``memplan.admission_budget``).
 """
 
 from __future__ import annotations
@@ -44,7 +52,7 @@ from ndstpu.harness import admission as adm
 from ndstpu.harness import power
 from ndstpu.harness.scheduler import StreamScheduler
 from ndstpu.obs import ledger as ledger_mod
-from ndstpu.serve import lifecycle, protocol
+from ndstpu.serve import lifecycle, protocol, transport
 from ndstpu.serve.overload import (AdmissionQueue, CircuitBreaker,
                                    Overloaded, Rejected, TenantBudgets)
 
@@ -56,7 +64,7 @@ DEFAULT_QUERY_TIMEOUT_S = 300.0
 
 @dataclasses.dataclass
 class ServeConfig:
-    socket_path: str
+    socket_path: str            # endpoint spec (unix path or tcp:H:P)
     input_prefix: Optional[str] = None
     engine: str = "cpu"
     output_prefix: Optional[str] = None
@@ -68,11 +76,15 @@ class ServeConfig:
     scale_factor: str = "unknown"
     floats: bool = False
     slots: int = 1
-    queue_depth: int = 64
+    queue_depth: Optional[int] = 64  # None/0 -> memplan admission model
     tenant_tokens: float = 64.0
     tenant_refill_per_s: float = 16.0
     breaker_cooldown_s: float = 5.0
     query_timeout_s: Optional[float] = None  # None -> env/default
+    tcp: Optional[str] = None       # extra TCP listener (HOST:PORT)
+    aot_corpus: Optional[str] = None  # stream file/dir to precompile
+    bind_early: bool = False        # answer probes while warming
+    replica_id: Optional[str] = None  # fleet identity in probe/health
 
     def resolved_timeout_s(self) -> float:
         if self.query_timeout_s is not None:
@@ -113,8 +125,9 @@ class QueryServer:
         self.draining = False
         self._drain_lock = threading.Lock()
         self._stopped = threading.Event()
-        self._listener: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
+        self._listeners: List[socket.socket] = []
+        self.endpoints: List[transport.Endpoint] = []
+        self._accept_threads: List[threading.Thread] = []
         self._conns: Dict[str, _Conn] = {}
         self._conns_lock = threading.Lock()
         self._conn_seq = 0
@@ -123,28 +136,48 @@ class QueryServer:
         self._saved_compiled = 0
         self._zombies: List[dict] = []
         self.drain_summary: Optional[dict] = None
+        self.aot_info: Optional[dict] = None
 
         self.retry_policy = faults.RetryPolicy.from_env()
         self.quarantine = faults.Quarantine()
         self.budgets = TenantBudgets(
             capacity=config.tenant_tokens,
             refill_per_s=config.tenant_refill_per_s)
-        self.queue = AdmissionQueue(depth=config.queue_depth)
+        # queue_depth None/0 asks the memplan device-memory model how
+        # many concurrently-admitted queries the budget supports — a
+        # clamped NDSTPU_HBM_BYTES sheds instead of queueing
+        self.admission_model: Optional[dict] = None
+        depth = config.queue_depth
+        if not depth:
+            from ndstpu.engine import memplan
+            self.admission_model = memplan.admission_budget()
+            depth = self.admission_model["depth"]
+        self.queue = AdmissionQueue(depth=depth)
         self.breaker = CircuitBreaker(
             self.quarantine, cooldown_s=config.breaker_cooldown_s)
         self.slo = lifecycle.SLOTracker()
         self.journal = lifecycle.ServeJournal(
             config.journal_path or "serve_journal.jsonl")
         self.gate = adm.InprocAdmission(config.slots)
-        self.scheduler: Optional[StreamScheduler] = None
+        # built here (not in start) so bind_early connections accepted
+        # while the session still warms get their stream immediately
+        self.scheduler: StreamScheduler = StreamScheduler(
+            {}, key_fn=lambda sql: self.session.canonical_key(sql))
         self.ledger: Optional[ledger_mod.Ledger] = None
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        """Build the session, warm-restart from the journal, bind the
-        socket, THEN flip readiness — a client that sees ready=True is
-        guaranteed the replayed warmth is already in place."""
+        """Build the session, warm-restart from the journal, precompile
+        the AOT corpus, bind the socket, THEN flip readiness — a client
+        that sees ready=True is guaranteed the replayed + precompiled
+        warmth is already in place.  With ``bind_early`` the listener
+        comes up first instead, answering probes (not-ready) and
+        shedding sql as retryable ``overloaded`` while warming — the
+        fleet supervisor's readiness gate."""
+        if self.config.bind_early:
+            self._bind()
+            self._start_accepting()
         if self.session is None:
             from ndstpu.io import loader
             if not self.config.input_prefix:
@@ -160,9 +193,8 @@ class QueryServer:
             self.session, self.journal,
             compile_records=self.config.compile_records
             if self._accel() else None)
+        self._aot_precompile()
         self._saved_compiled = self.session.compiled_count()
-        self.scheduler = StreamScheduler(
-            {}, key_fn=lambda sql: self.session.canonical_key(sql))
         if self.config.ledger_path and \
                 self.config.ledger_path.lower() != "none":
             try:
@@ -172,30 +204,77 @@ class QueryServer:
         self.journal.mark_start({
             "engine": self.config.engine,
             "warm": restart,
+            "aot": self.aot_info,
             "pid": os.getpid()})
-        self._bind()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="serve-accept", daemon=True)
+        if not self._listeners:
+            self._bind()
         self.ready = True
-        self._accept_thread.start()
+        self._start_accepting()
         obs.inc("serve.started")
-        print(f"[serve] ready on {self.config.socket_path} "
+        print(f"[serve] ready on "
+              f"{','.join(ep.spec for ep in self.endpoints)} "
               f"(engine={self.config.engine}, slots={self.config.slots},"
-              f" warm={restart})")
+              f" depth={self.queue.depth}, warm={restart})")
 
     def _accel(self) -> bool:
         return self.config.engine in ("tpu", "tpu-spmd")
 
     def _bind(self) -> None:
-        path = self.config.socket_path
-        if os.path.exists(path):
-            os.unlink(path)
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        ls.bind(path)
-        ls.listen(64)
-        self._listener = ls
+        specs = [self.config.socket_path]
+        if self.config.tcp:
+            tcp = str(self.config.tcp)
+            specs.append(tcp if tcp.startswith("tcp:") else f"tcp:{tcp}")
+        for ep in transport.parse_endpoints(specs):
+            ls = transport.listen(ep)
+            self._listeners.append(ls)
+            self.endpoints.append(transport.bound_endpoint(ls))
+
+    def _start_accepting(self) -> None:
+        if self._accept_threads:
+            return  # bind_early already started them
+        for i, ls in enumerate(self._listeners):
+            th = threading.Thread(
+                target=self._accept_loop, args=(ls,),
+                name=f"serve-accept-{i}", daemon=True)
+            self._accept_threads.append(th)
+            th.start()
+
+    def _aot_precompile(self) -> None:
+        """Full-corpus AOT warmth before readiness: plan every query in
+        the configured stream file(s) (``canonical_key`` registers the
+        fingerprint + plan cache without executing), so combined with
+        preloaded compile records a replica's first seen-shape query
+        compiles nothing.  Defects degrade to cold queries, never a
+        failed boot."""
+        corpus = self.config.aot_corpus
+        if not corpus:
+            return
+        t0 = time.time()
+        import glob as _glob
+        if os.path.isdir(corpus):
+            files = sorted(_glob.glob(os.path.join(corpus, "query_*.sql")))
+        else:
+            files = [corpus]
+        planned = errors = 0
+        for path in files:
+            try:
+                queries = power.gen_sql_from_stream(path)
+            except Exception as e:  # noqa: BLE001
+                print(f"WARNING: aot corpus {path} unreadable: {e}")
+                errors += 1
+                continue
+            for name, sql in queries.items():
+                try:
+                    self.session.canonical_key(sql)
+                    planned += 1
+                except Exception as e:  # noqa: BLE001
+                    errors += 1
+                    print(f"WARNING: aot precompile skipped {name}: {e}")
+        self.aot_info = {"files": len(files), "planned": planned,
+                         "errors": errors,
+                         "wall_s": round(time.time() - t0, 3)}
+        obs.inc("serve.aot.planned", planned)
+        print(f"[serve] aot precompile: {self.aot_info}")
 
     def serve_forever(self) -> None:
         self.start()
@@ -214,9 +293,9 @@ class QueryServer:
         obs.inc("serve.drain.initiated")
         print(f"[serve] draining ({reason}): admission stopped, "
               f"finishing in-flight queries")
-        if self._listener is not None:
+        for ls in self._listeners:
             try:
-                self._listener.close()
+                ls.close()
             except OSError:
                 pass
         with self._conns_lock:
@@ -258,12 +337,13 @@ class QueryServer:
 
     # -- accept / per-connection threads -------------------------------------
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener: socket.socket) -> None:
         while not self.draining:
             try:
-                sock, _addr = self._listener.accept()
+                sock, _addr = listener.accept()
             except OSError:
                 break  # listener closed by drain
+            transport.configure(sock)  # per-connection read timeout
             try:
                 faults.check("serve.accept")
             except Exception as e:  # noqa: BLE001 — injected fault:
@@ -322,6 +402,9 @@ class QueryServer:
         elif op == "health":
             conn.send({"status": "ok", "id": rid,
                        "health": self.health()})
+        elif op == "probe":
+            conn.send({"status": "ok", "id": rid,
+                       "probe": self.probe_doc()})
         elif op == "stats":
             conn.send({"status": "ok", "id": rid,
                        "counters": obs.counters_snapshot(),
@@ -354,10 +437,20 @@ class QueryServer:
                        "error": "sql op needs a 'sql' string",
                        "taxonomy": "permanent"})
             return
-        if self.draining or not self.ready:
+        if self.draining:
             obs.inc("serve.draining_rejects")
             conn.send({"status": "draining", "id": rid,
                        "error": "server is draining"})
+            return
+        if not self.ready:
+            # bind_early boot: the listener answers before the session
+            # is warm.  Retryable overload (NOT draining) so a fleet
+            # client's retry lands on a ready sibling and a lone
+            # client just backs off until readiness flips.
+            obs.inc("serve.warming_rejects")
+            conn.send({"status": "overloaded", "id": rid,
+                       "error": "server warming up (not ready)",
+                       "retry_after_s": 0.25})
             return
         try:
             self.budgets.acquire(tenant)
@@ -433,6 +526,17 @@ class QueryServer:
                          tenant=tenant, serve=1)
         t0 = time.time()
         try:
+            # chaos-only: an injected replica crash takes the WHOLE
+            # process down mid-flight (fleet_smoke scenario 2 without
+            # needing an external SIGKILL) — the supervisor restarts
+            # us, the client fails over to a sibling
+            faults.check("serve.replica.crash", key=name)
+        except faults.InjectedFault:
+            obs.inc("serve.replica.crashed")
+            print(f"[serve] injected replica crash on {name}; exiting",
+                  flush=True)
+            os._exit(17)
+        try:
             # pre-retry, client-visible: an injected dispatch fault
             # reaches the client as a typed transient error and the
             # CLIENT retries (serve_smoke leg 2)
@@ -466,6 +570,7 @@ class QueryServer:
         wall = qspan.wall_s or (time.time() - t0)
         obs.inc("serve.ok")
         self.breaker.note_success(canon)
+        self.queue.observe(wall)  # EWMA behind retry_after_s hints
         self.slo.record(tenant, wall, "ok")
         self.journal.mark_query(name, req["sql"], canon_key=canon)
         self._persist_compiled()
@@ -601,6 +706,22 @@ class QueryServer:
         except Exception as e:  # noqa: BLE001 — ledger never fails a
             print(f"WARNING: serve ledger append failed: {e}")  # query
 
+    def probe_doc(self) -> dict:
+        """The fleet supervisor's liveness/readiness view.  Cheap —
+        answered even while a ``bind_early`` boot is still warming."""
+        return {
+            "alive": True,
+            "ready": self.ready and not self.draining,
+            "draining": self.draining,
+            "pid": os.getpid(),
+            "replica_id": self.config.replica_id,
+            "endpoints": [ep.spec for ep in self.endpoints],
+            "started_at": self._started_at,
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "aot": self.aot_info,
+            "queue": self.queue.snapshot(),
+        }
+
     def health(self) -> dict:
         c = obs.counters_snapshot()
         return {
@@ -609,9 +730,14 @@ class QueryServer:
             "draining": self.draining,
             "uptime_s": round(time.time() - self._started_at, 3),
             "engine": self.config.engine,
+            "replica_id": self.config.replica_id,
+            "endpoints": [ep.spec for ep in self.endpoints],
             "connections": len(self._conns),
             "admitted": self.queue.admitted,
             "admitted_peak": self.queue.peak,
+            "queue_depth": self.queue.depth,
+            "est_wait_s": round(self.queue.est_wait_s, 6),
+            "admission_model": self.admission_model,
             "compiled": self.session.compiled_count()
             if self.session is not None else 0,
             "zombies": sum(1 for z in self._zombies

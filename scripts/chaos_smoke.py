@@ -46,6 +46,15 @@ H. **Kill the query server mid-flight** — a ``ndstpu.harness.serve``
    control server (the compile-cache warm-restart proof lives in
    scripts/serve_smoke.py leg 4 — this scenario gates the client-side
    crash contract).
+I. **Kill the fleet supervisor** — a 2-replica
+   ``ndstpu.serve.fleet`` supervisor is SIGKILLed while its replicas
+   serve.  The replicas (own process sessions) must keep answering
+   supervisor-less; a supervisor restarted over the same ``run_dir``
+   must **re-adopt** the live replicas from probe state — same pids,
+   ``serve.fleet.adopted >= 2``, zero restarts, no double-start —
+   then drain the fleet cleanly on SIGTERM (the load-bearing fleet
+   proofs live in scripts/fleet_smoke.py — this scenario gates the
+   supervisor's own crash contract).
 """
 from __future__ import annotations
 
@@ -446,7 +455,83 @@ def main() -> int:
     print("serve SIGKILL scenario OK: client reconnect-retried to "
           f"control-identical results for {len(control)} queries")
 
-    print("chaos smoke OK: crash + 4 SIGKILLs resumed to "
+    # ---- I. SIGKILL the fleet supervisor; replicas keep serving -----
+    fleet_dir = work / "fleet_i"
+    health_path = fleet_dir / "FLEET_HEALTH.json"
+
+    def start_fleet_i(log_path: pathlib.Path) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "ndstpu.harness.serve", "fleet",
+               "--replicas", "2",
+               "--input_prefix", str(root_b / "wh"),
+               "--engine", "cpu", "--run_dir", str(fleet_dir),
+               "--ledger", "none", "--probe_interval_s", "0.25"]
+        print("+", " ".join(cmd), flush=True)
+        f = open(log_path, "a")
+        return subprocess.Popen(cmd, env=base_env(), stdout=f,
+                                stderr=subprocess.STDOUT)
+
+    def fleet_doc() -> dict:
+        try:
+            return json.loads(health_path.read_text())
+        except (OSError, ValueError):
+            return {}
+
+    def wait_fleet(cond, what: str, timeout_s: float = 600.0) -> dict:
+        t0 = time.time()
+        while True:
+            doc = fleet_doc()
+            reps = doc.get("replicas") or []
+            if len(reps) == 2 and all(r.get("ready") for r in reps) \
+                    and cond(doc):
+                return doc
+            assert time.time() - t0 < timeout_s, \
+                f"fleet never reached {what}: {doc}"
+            time.sleep(0.25)
+
+    p_sup = start_fleet_i(work / "i_fleet.log")
+    doc_i = wait_fleet(lambda d: True, "2 ready replicas")
+    pids_before = sorted(r["pid"] for r in doc_i["replicas"])
+    endpoints_i = doc_i["endpoints"]
+
+    p_sup.kill()  # SIGKILL the supervisor ONLY: no drain, no goodbye
+    p_sup.wait(timeout=60)
+
+    # replicas were launched in their own sessions: they must keep
+    # serving, supervisor-less
+    cli_i = ServeClient(endpoints_i, retries=4)
+    sql_i = next(iter(qd_h.values()))
+    orphan = cli_i.sql(sql_i, max_rows=100000)["data"]
+    assert orphan == control[0], \
+        "orphaned replicas answered differently from the control"
+
+    # a supervisor restarted over the same run_dir probes the same
+    # stable endpoints and re-adopts the live replicas — same pids,
+    # no double-start, no restarts
+    p_sup2 = start_fleet_i(work / "i_fleet.log")
+    doc_i = wait_fleet(
+        lambda d: d.get("supervisor_pid") == p_sup2.pid,
+        "re-adoption by the restarted supervisor")
+    pids_after = sorted(r["pid"] for r in doc_i["replicas"])
+    assert pids_after == pids_before, \
+        (f"restarted supervisor double-started replicas: "
+         f"{pids_before} -> {pids_after}")
+    assert all(r.get("adopted") for r in doc_i["replicas"]), \
+        doc_i["replicas"]
+    assert doc_i["counters"].get("serve.fleet.adopted", 0) >= 2, \
+        doc_i["counters"]
+    assert all(not r.get("restarts") for r in doc_i["replicas"]), \
+        doc_i["replicas"]
+    again = cli_i.sql(sql_i, max_rows=100000)["data"]
+    assert again == control[0]
+    cli_i.close()
+    p_sup2.terminate()
+    assert p_sup2.wait(timeout=180) == 0, \
+        "re-adopting supervisor failed to drain on SIGTERM"
+    print("fleet supervisor SIGKILL scenario OK: replicas served "
+          f"supervisor-less; restart re-adopted pids {pids_after} "
+          "without double-starting")
+
+    print("chaos smoke OK: crash + 5 SIGKILLs resumed to "
           "baseline-identical results; permanent fault surfaced "
           "classified")
     shutil.rmtree(work, ignore_errors=True)

@@ -1,0 +1,463 @@
+"""Fleet smoke: the replicated serving fleet under its four fates.
+
+CI gate for ndstpu/serve/fleet.py (docs/ROBUSTNESS.md "Fleet
+lifecycle").  One tiny warehouse, a serial ``power.py`` ground truth,
+then fleet runs of N replicas x M failover clients
+(``throughput --mode serve`` with a comma-separated fleet spec):
+
+1. **Clean** — M concurrent clients over N replicas produce per-query
+   parquet outputs **byte-identical** to the serial power runs, with
+   per-replica attribution in the overlap report.  Then a FRESH
+   replica booted with ``--aot_corpus`` + the fleet's shared compile
+   records serves its first seen-shape query with
+   ``engine.cache.compiled.miss`` delta 0.
+2. **Replica SIGKILL mid-flight** — one serving replica is kill -9'd
+   while clients stream; they fail over (``client.failovers >= 1``),
+   ZERO queries fail, outputs stay byte-identical, and the supervisor
+   restarts the dead replica with backoff.
+3. **Rolling restart** — SIGHUP to the supervisor rolls every replica
+   (drain one, others serve) while clients stream: zero failed
+   queries, byte-identical outputs, every replica restarted exactly
+   once.
+4. **Memory-model backpressure** — ``NDSTPU_HBM_BYTES`` clamped +
+   ``--queue_depth auto`` derive per-replica admission depth 1 from
+   the memplan budget: overloaded replicas shed early, retries land
+   on siblings, outputs stay byte-identical; the run prints the shed
+   vs single-queueing-server p99 comparison (asserted only under
+   NDSTPU_FLEET_SMOKE_STRICT=1 — CI boxes are too noisy for a hard
+   latency gate).
+
+Engine is ``tpu`` (jaxexec under JAX_PLATFORMS=cpu) so the shared
+compile-record artifact — the thing that makes replica boots
+zero-new-compiles — is actually in play.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SUBQ = "query3,query42,query96"
+
+
+def env_for(**extra) -> dict:
+    env = dict(os.environ, PYTHONPATH=str(REPO),
+               JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("NDSTPU_FAULTS", None)
+    env.pop("NDSTPU_HBM_BYTES", None)
+    env.update({k: str(v) for k, v in extra.items() if v is not None})
+    return env
+
+
+def run(cmd, **kw):
+    print("+", " ".join(map(str, cmd)), flush=True)
+    return subprocess.run([str(c) for c in cmd], **kw)
+
+
+def parquet_tree(prefix: pathlib.Path) -> dict:
+    return {str(p.relative_to(prefix)): p.read_bytes()
+            for p in sorted(prefix.rglob("part-0.parquet"))}
+
+
+def assert_byte_identical(got: pathlib.Path, want: pathlib.Path,
+                          leg: str) -> int:
+    g, w = parquet_tree(got), parquet_tree(want)
+    assert set(g) == set(w), \
+        f"{leg}: output sets differ: {sorted(set(g) ^ set(w))}"
+    for rel in w:
+        assert g[rel] == w[rel], \
+            f"{leg}: {rel} differs from the serial power run"
+    return len(w)
+
+
+def start_fleet(root: pathlib.Path, tag: str, replicas: int,
+                out: pathlib.Path, aot_corpus=None,
+                compile_records=None, queue_depth="64",
+                env=None) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "ndstpu.harness.serve", "fleet",
+           "--input_prefix", root / "wh", "--engine", "tpu",
+           "--replicas", str(replicas),
+           "--run_dir", root / f"fleet_{tag}",
+           "--output_prefix", out, "--output_format", "parquet",
+           "--queue_depth", queue_depth,
+           "--probe_interval_s", "0.25"]
+    if aot_corpus:
+        cmd += ["--aot_corpus", aot_corpus]
+    if compile_records:
+        cmd += ["--compile_records", compile_records]
+    log = open(root / f"fleet_{tag}.log", "a")
+    print("+", " ".join(map(str, cmd)), flush=True)
+    return subprocess.Popen([str(c) for c in cmd],
+                            env=env or env_for(),
+                            stdout=log, stderr=subprocess.STDOUT)
+
+
+def fleet_health(root: pathlib.Path, tag: str) -> dict:
+    path = root / f"fleet_{tag}" / "FLEET_HEALTH.json"
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def wait_fleet_ready(root: pathlib.Path, tag: str, n: int,
+                     timeout_s: float = 600.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        doc = fleet_health(root, tag)
+        reps = doc.get("replicas") or []
+        if len(reps) == n and all(r.get("ready") for r in reps):
+            return doc
+        time.sleep(0.25)
+    raise AssertionError(
+        f"fleet {tag} never got {n} replicas ready: "
+        f"{fleet_health(root, tag)}")
+
+
+def throughput_serve(root: pathlib.Path, endpoints: str, streams: str,
+                     out: pathlib.Path, report: pathlib.Path,
+                     **popen_kw) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "ndstpu.harness.throughput", streams,
+           "--mode", "serve", "--serve_socket", endpoints,
+           "--overlap_report", report,
+           "--", sys.executable, "-m", "ndstpu.harness.power",
+           str(root / "streams") + "/query_{}.sql", root / "wh",
+           str(root) + "/t_{}.csv", "--input_format", "ndslake",
+           "--output_prefix", out, "--sub_queries", SUBQ]
+    print("+", " ".join(map(str, cmd)), flush=True)
+    return subprocess.Popen([str(c) for c in cmd], env=env_for(),
+                            **popen_kw)
+
+
+def wait_first_output(out: pathlib.Path, timeout_s: float = 600.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if list(out.rglob("part-0.parquet")):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"no output ever appeared under {out}")
+
+
+def one_shot_health(endpoint: str) -> dict:
+    from ndstpu.serve.client import ServeClient
+    cli = ServeClient(endpoint, retries=0, connect_timeout_s=3.0)
+    try:
+        return cli.health()
+    except Exception as e:  # noqa: BLE001 — dead replica is data too
+        return {"alive": False, "error": str(e)}
+    finally:
+        cli.close()
+
+
+def check_overlap(report: pathlib.Path, leg: str,
+                  want_failovers: bool = False) -> dict:
+    ov = json.loads(report.read_text())
+    assert ov["mode"] == "serve", ov.get("mode")
+    assert all(s["returncode"] == 0 for s in ov["streams"]), \
+        f"{leg}: a stream failed: {ov['streams']}"
+    assert all(s["failures"] == 0 for s in ov["streams"]), \
+        f"{leg}: failed queries: {ov['streams']}"
+    total = ov.get("failovers_total", 0)
+    if want_failovers:
+        assert total >= 1, \
+            f"{leg}: clients never failed over (failovers_total=0)"
+    return ov
+
+
+def max_p99_ms(endpoints: list) -> float:
+    """Worst per-tenant ok-p99 across the given replicas."""
+    from ndstpu.serve.client import ServeClient
+    worst = 0.0
+    for ep in endpoints:
+        cli = ServeClient(ep, retries=0, connect_timeout_s=3.0)
+        try:
+            slo = cli.stats().get("slo") or {}
+            for doc in (slo.get("tenants") or {}).values():
+                worst = max(worst, float(doc.get("p99_ms") or 0.0))
+        except Exception:  # noqa: BLE001 — evidence only
+            pass
+        finally:
+            cli.close()
+    return worst
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--clients", type=int, default=4)
+    args = ap.parse_args()
+    n_rep, n_cli = args.replicas, args.clients
+    streams = ",".join(str(i) for i in range(1, n_cli + 1))
+
+    root = pathlib.Path(tempfile.mkdtemp(prefix="ndstpu_fleet_smoke"))
+    py = [sys.executable, "-m"]
+    run(py + ["ndstpu.datagen.driver", "local", "0.002", "2",
+              root / "raw"], check=True, env=env_for())
+    run(py + ["ndstpu.io.transcode", "--input_prefix", root / "raw",
+              "--output_prefix", root / "wh",
+              "--report_file", root / "load.txt",
+              "--output_format", "ndslake"],
+        check=True, env=env_for(), stdout=subprocess.DEVNULL)
+    run(py + ["ndstpu.queries.streamgen", "--output_dir",
+              root / "streams", "--rngseed", "07291122510",
+              "--streams", str(n_cli + 1)],
+        check=True, env=env_for(), stdout=subprocess.DEVNULL)
+
+    from ndstpu.harness import power
+
+    # a mini AOT corpus: just the SUBQ blocks of stream 1 (single-
+    # statement templates keep their stream markers, so the subset
+    # file re-parses with gen_sql_from_stream)
+    qd1 = power.get_query_subset(
+        power.gen_sql_from_stream(root / "streams" / "query_1.sql"),
+        SUBQ.split(","))
+    corpus = root / "aot_corpus.sql"
+    corpus.write_text("\n".join(qd1.values()))
+
+    # ---- serial ground truth ----------------------------------------
+    serial = root / "serial_out"
+    for sid in streams.split(","):
+        run(py + ["ndstpu.harness.power",
+                  root / "streams" / f"query_{sid}.sql", root / "wh",
+                  root / f"serial_time_{sid}.csv",
+                  "--engine", "tpu", "--input_format", "ndslake",
+                  "--output_prefix", serial / f"query_{sid}",
+                  "--sub_queries", SUBQ],
+            check=True, env=env_for(), stdout=subprocess.DEVNULL)
+    n_serial = len(parquet_tree(serial))
+    assert n_serial == n_cli * len(SUBQ.split(",")), \
+        f"serial baseline wrote {n_serial} outputs"
+
+    # ---- scenario 1: clean fleet parity + per-replica attribution ---
+    out1 = root / "out1"
+    fl1 = start_fleet(root, "s1", n_rep, out1, aot_corpus=corpus)
+    shared_records = None
+    try:
+        doc = wait_fleet_ready(root, "s1", n_rep)
+        endpoints = doc["endpoints"]
+        shared_records = doc["shared_compile_records"]
+        rep1 = root / "overlap1.json"
+        r = throughput_serve(root, endpoints, streams, out1, rep1)
+        assert r.wait(timeout=1200) == 0, "scenario 1 throughput failed"
+        n = assert_byte_identical(out1, serial, "scenario1")
+        ov = check_overlap(rep1, "scenario1")
+        attrib = ov.get("replica_health") or {}
+        assert len(attrib) == n_rep, \
+            f"overlap report lacks per-replica attribution: {attrib}"
+        served = {ep: h.get("ok", 0) for ep, h in attrib.items()}
+        print(f"scenario 1 OK: {n} fleet outputs byte-identical to "
+              f"serial; per-replica ok counts {served}")
+    finally:
+        fl1.send_signal(signal.SIGTERM)
+        fl1.wait(timeout=180)
+
+    # ---- scenario 1b: fresh --aot_corpus replica, zero compiles -----
+    from ndstpu.serve.client import ServeClient
+    sock1b = root / "s1b.sock"
+    cmd = [sys.executable, "-m", "ndstpu.harness.serve", "server",
+           "--socket", sock1b, "--input_prefix", root / "wh",
+           "--engine", "tpu", "--state_dir", root / "state_1b",
+           "--compile_records", shared_records,
+           "--aot_corpus", corpus, "--bind_early",
+           "--replica_id", "fresh", "--ledger", "none"]
+    log = open(root / "server_1b.log", "w")
+    print("+", " ".join(map(str, cmd)), flush=True)
+    srv1b = subprocess.Popen([str(c) for c in cmd], env=env_for(),
+                             stdout=log, stderr=subprocess.STDOUT)
+    try:
+        cli = ServeClient(str(sock1b), retries=8,
+                          connect_timeout_s=180.0)
+        assert cli.wait_ready(300.0), "aot replica never ready"
+        probe = cli.probe()
+        assert probe["replica_id"] == "fresh"
+        assert (probe.get("aot") or {}).get("planned", 0) >= \
+            len(SUBQ.split(",")), f"aot precompile missing: {probe}"
+        miss0 = cli.request({"op": "stats"})["counters"].get(
+            "engine.cache.compiled.miss", 0)
+        first = cli.sql(next(iter(qd1.values())))
+        miss1 = cli.request({"op": "stats"})["counters"].get(
+            "engine.cache.compiled.miss", 0)
+        assert first["status"] == "ok"
+        assert miss1 == miss0, \
+            (f"fresh --aot_corpus replica compiled on its first "
+             f"seen-shape query: miss {miss0} -> {miss1}")
+        cli.close()
+        print(f"scenario 1b OK: fresh aot replica served its first "
+              f"seen-shape query with compiled.miss delta 0")
+    finally:
+        srv1b.send_signal(signal.SIGTERM)
+        srv1b.wait(timeout=120)
+
+    # ---- scenario 2: replica SIGKILL mid-flight ---------------------
+    out2 = root / "out2"
+    fl2 = start_fleet(root, "s2", n_rep, out2,
+                      compile_records=shared_records)
+    try:
+        doc = wait_fleet_ready(root, "s2", n_rep)
+        endpoints = doc["endpoints"]
+        rep2 = root / "overlap2.json"
+        r = throughput_serve(root, endpoints, streams, out2, rep2)
+        wait_first_output(out2)
+        # kill a replica that is actually serving connections
+        victim = None
+        for rdoc in fleet_health(root, "s2")["replicas"]:
+            h = one_shot_health(rdoc["endpoint"])
+            if h.get("alive") and h.get("connections", 0) >= 1:
+                victim = rdoc
+                break
+        victim = victim or fleet_health(root, "s2")["replicas"][0]
+        print(f"scenario 2: SIGKILL {victim['replica_id']} "
+              f"pid={victim['pid']} mid-flight")
+        os.kill(int(victim["pid"]), signal.SIGKILL)
+        assert r.wait(timeout=1200) == 0, \
+            "scenario 2 throughput failed after replica kill"
+        n = assert_byte_identical(out2, serial, "scenario2")
+        ov = check_overlap(rep2, "scenario2", want_failovers=True)
+        # the supervisor restarted the victim
+        deadline = time.monotonic() + 120
+        restarted = False
+        while time.monotonic() < deadline and not restarted:
+            for rdoc in (fleet_health(root, "s2").get("replicas")
+                         or []):
+                if rdoc["replica_id"] == victim["replica_id"] and \
+                        rdoc.get("restarts", 0) >= 1 and \
+                        rdoc.get("ready"):
+                    restarted = True
+            time.sleep(0.25)
+        assert restarted, "supervisor never restarted the victim"
+        print(f"scenario 2 OK: {n} outputs byte-identical through a "
+              f"replica SIGKILL; failovers="
+              f"{ov['failovers_total']}, zero failed "
+              f"queries, victim restarted")
+    finally:
+        fl2.send_signal(signal.SIGTERM)
+        fl2.wait(timeout=300)
+
+    # ---- scenario 3: rolling restart under load ---------------------
+    out3 = root / "out3"
+    fl3 = start_fleet(root, "s3", n_rep, out3,
+                      compile_records=shared_records)
+    try:
+        doc = wait_fleet_ready(root, "s3", n_rep)
+        endpoints = doc["endpoints"]
+        rep3 = root / "overlap3.json"
+        r = throughput_serve(root, endpoints, streams, out3, rep3)
+        wait_first_output(out3)
+        print("scenario 3: SIGHUP -> rolling restart of all replicas")
+        fl3.send_signal(signal.SIGHUP)
+        assert r.wait(timeout=1800) == 0, \
+            "scenario 3 throughput failed during rolling restart"
+        n = assert_byte_identical(out3, serial, "scenario3")
+        ov = check_overlap(rep3, "scenario3")
+        retries = {s["stream"]: s["client_retries"]
+                   for s in ov["streams"]}
+        # the sweep rolls one replica at a time (N-1 stay ready the
+        # whole way), so the load can finish before the last replica
+        # has been rolled — poll until the sweep has visited all N
+        deadline = time.monotonic() + 300.0
+        doc = wait_fleet_ready(root, "s3", n_rep, timeout_s=300.0)
+        while time.monotonic() < deadline:
+            doc = wait_fleet_ready(root, "s3", n_rep, timeout_s=300.0)
+            rolled = [rd for rd in doc["replicas"]
+                      if rd.get("restarts", 0) >= 1 and rd.get("ready")]
+            if len(rolled) == n_rep:
+                break
+            time.sleep(0.25)
+        assert doc["counters"].get(
+            "serve.fleet.rolling_restarts", 0) >= 1, doc["counters"]
+        rolled = [rd for rd in doc["replicas"]
+                  if rd.get("restarts", 0) >= 1]
+        assert len(rolled) == n_rep, \
+            f"rolling restart missed replicas: {doc['replicas']}"
+        print(f"scenario 3 OK: {n} outputs byte-identical through a "
+              f"rolling restart of {n_rep} replicas; zero failed "
+              f"queries (client retries per stream: {retries})")
+    finally:
+        fl3.send_signal(signal.SIGTERM)
+        fl3.wait(timeout=300)
+
+    # ---- scenario 4: memory-model backpressure ----------------------
+    # a clamped device budget + queue_depth auto => admission depth 1
+    # per replica: overload sheds early and retries land on siblings
+    out4 = root / "out4"
+    clamp_env = env_for(NDSTPU_HBM_BYTES=str(192 << 20))
+    fl4 = start_fleet(root, "s4", n_rep, out4,
+                      compile_records=shared_records,
+                      queue_depth="auto", env=clamp_env)
+    try:
+        doc = wait_fleet_ready(root, "s4", n_rep)
+        endpoints = doc["endpoints"]
+        h0 = one_shot_health(endpoints.split(",")[0])
+        model = h0.get("admission_model") or {}
+        assert model.get("budget_source") == "env", model
+        assert h0.get("queue_depth") == model.get("depth"), h0
+        rep4 = root / "overlap4.json"
+        r = throughput_serve(root, endpoints, streams, out4, rep4)
+        assert r.wait(timeout=1800) == 0, "scenario 4 throughput failed"
+        n = assert_byte_identical(out4, serial, "scenario4")
+        ov = check_overlap(rep4, "scenario4")
+        attrib = ov.get("replica_health") or {}
+        sheds = sum(h.get("overloaded", 0) for h in attrib.values())
+        failovers = ov.get("failovers_total", 0)
+        assert sheds >= 1 or failovers >= 1, \
+            (f"memory-starved fleet never shed or failed over "
+             f"(sheds={sheds} failovers={failovers})")
+        fleet_p99 = max_p99_ms(endpoints.split(","))
+    finally:
+        fl4.send_signal(signal.SIGTERM)
+        fl4.wait(timeout=300)
+
+    # control: ONE server with the static depth-64 queue, same load —
+    # every request queues behind a single admission gate
+    sock4b = root / "s4b.sock"
+    out4b = root / "out4b"
+    cmd = [sys.executable, "-m", "ndstpu.harness.serve", "server",
+           "--socket", sock4b, "--input_prefix", root / "wh",
+           "--engine", "tpu", "--output_prefix", out4b,
+           "--output_format", "parquet",
+           "--state_dir", root / "state_4b",
+           "--compile_records", shared_records,
+           "--queue_depth", "64", "--ledger", "none"]
+    log = open(root / "server_4b.log", "w")
+    print("+", " ".join(map(str, cmd)), flush=True)
+    srv4b = subprocess.Popen([str(c) for c in cmd], env=env_for(),
+                             stdout=log, stderr=subprocess.STDOUT)
+    try:
+        r = throughput_serve(root, str(sock4b), streams, out4b,
+                             root / "overlap4b.json")
+        assert r.wait(timeout=1800) == 0, "scenario 4 control failed"
+        control_p99 = max_p99_ms([str(sock4b)])
+    finally:
+        srv4b.send_signal(signal.SIGTERM)
+        srv4b.wait(timeout=180)
+    verdict = ("beats" if fleet_p99 and control_p99
+               and fleet_p99 <= control_p99 else "does not beat")
+    print(f"scenario 4 OK: {n} outputs byte-identical under clamped "
+          f"HBM (depth={model.get('depth')}, sheds={sheds}, "
+          f"failovers={failovers}); shed-and-failover p99 "
+          f"{fleet_p99:.0f}ms {verdict} single-queue p99 "
+          f"{control_p99:.0f}ms")
+    if os.environ.get("NDSTPU_FLEET_SMOKE_STRICT") == "1":
+        assert fleet_p99 <= control_p99, \
+            (f"strict mode: fleet p99 {fleet_p99:.0f}ms worse than "
+             f"queueing control {control_p99:.0f}ms")
+
+    print(f"fleet smoke OK: clean parity, aot zero-compile, replica "
+          f"kill, rolling restart, memory backpressure all held "
+          f"({n_rep} replicas x {n_cli} clients)")
+    import shutil
+    shutil.rmtree(root, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

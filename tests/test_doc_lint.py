@@ -131,3 +131,17 @@ def test_ingest_diff_citation_is_recognized_but_runtime_exempt(tmp_path):
     assert not any("INGEST_DIFF" in f for f in findings)
     assert any("INGEST_DIFF.json" in m.group(0)
                for m in artifact_lint.CITED_RE.finditer(text))
+
+
+def test_fleet_health_citation_is_recognized_but_runtime_exempt(tmp_path):
+    """`FLEET_HEALTH.json` is the fleet supervisor's per-run artifact
+    (serve/fleet.py): recognized as a citation, exempt from the
+    committed-file existence check."""
+    text = ("the supervisor writes `FLEET_HEALTH.json` per monitor pass\n"
+            "and cites `docs/GHOST.json` for numbers\n")
+    (tmp_path / "docs").mkdir()
+    findings = artifact_lint.lint_text(text, str(tmp_path), doc="d.md")
+    assert len(findings) == 1 and "GHOST" in findings[0]
+    assert not any("FLEET_HEALTH" in f for f in findings)
+    assert any("FLEET_HEALTH.json" in m.group(0)
+               for m in artifact_lint.CITED_RE.finditer(text))

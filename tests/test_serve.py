@@ -15,6 +15,7 @@ connection-fault taxonomy, journal replay, and the warm-restart
 zero-new-compiles invariant the serve smoke proves cross-process.
 """
 
+import json
 import os
 import socket
 import threading
@@ -31,8 +32,8 @@ from ndstpu.harness.scheduler import StreamScheduler
 from ndstpu.io import atomic
 from ndstpu.io.loader import Catalog
 from ndstpu.obs import artifact_lint
-from ndstpu.serve import lifecycle, protocol
-from ndstpu.serve.client import ServeClient
+from ndstpu.serve import lifecycle, protocol, transport
+from ndstpu.serve.client import NoHealthyEndpoint, ServeClient
 from ndstpu.serve.overload import (AdmissionQueue, CircuitBreaker,
                                    Overloaded, Rejected, TenantBudgets)
 from ndstpu.serve.server import QueryServer, ServeConfig
@@ -380,7 +381,8 @@ def test_warm_restart_zero_new_compiles(tmp_path):
     # no clean drain: simulate the SIGKILL by never calling drain() —
     # the incremental persistence must already have saved the records
     assert os.path.exists(records)
-    srv1._listener.close()
+    for ls in srv1._listeners:
+        ls.close()
 
     cfg2 = dict(cfg, socket_path=str(tmp_path / "warm2.sock"))
     srv2 = QueryServer(ServeConfig(**cfg2),
@@ -422,3 +424,357 @@ def test_artifact_lint_recognizes_slo_as_runtime():
     assert artifact_lint.lint_text(text, root="/nonexistent") == []
     assert any(p == "SLO.json" for _, p, _ in
                artifact_lint.cited_artifacts(text))
+
+
+def test_artifact_lint_recognizes_fleet_health_as_runtime():
+    text = "each tick rewrites `FLEET_HEALTH.json` in the run dir"
+    assert artifact_lint.lint_text(text, root="/nonexistent") == []
+    assert any(p == "FLEET_HEALTH.json" for _, p, _ in
+               artifact_lint.cited_artifacts(text))
+
+
+# -- fleet satellites: transports, failover, readiness, backpressure ---------
+
+def test_tcp_unix_parity_same_request_same_response(serve_env):
+    """Satellite 3: the SAME request sent over AF_UNIX and TCP gets
+    the SAME response — shared framing, shared dispatch; only the
+    volatile wall clock may differ."""
+    srv, _cli = serve_env(tcp="127.0.0.1:0")
+    specs = [ep.spec for ep in srv.endpoints]
+    assert any(s.startswith("unix:") for s in specs), specs
+    assert any(s.startswith("tcp:") for s in specs), specs
+
+    def ask(spec, msg):
+        s = transport.connect(spec, connect_timeout_s=10.0)
+        try:
+            protocol.send_msg(s, msg)
+            return protocol.recv_msg(s)
+        finally:
+            s.close()
+
+    for msg in (
+            {"op": "ping", "id": "par-1"},
+            {"op": "ready", "id": "par-2"},
+            {"op": "sql", "id": "par-3", "tenant": "parity",
+             "sql": "SELECT b, sum(a) AS s FROM t GROUP BY b "
+                    "ORDER BY b"}):
+        answers = []
+        for spec in specs:
+            resp = ask(spec, dict(msg))
+            resp.pop("wall_s", None)
+            answers.append(resp)
+        assert answers[0] == answers[1], \
+            f"transport-dependent response for {msg['op']}: {answers}"
+
+
+def _tenant_for_index(idx: int, n: int) -> str:
+    import zlib
+    for i in range(1000):
+        t = f"t{i}"
+        if zlib.crc32(t.encode()) % n == idx:
+            return t
+    raise AssertionError("unreachable")
+
+
+def test_client_fails_over_from_refused_endpoint(serve_env, tmp_path):
+    """Satellite 3: first endpoint refuses -> the client silently
+    moves to the next and counts the switch in ``failovers``."""
+    srv, _cli = serve_env()
+    live = srv.endpoints[0].spec
+    dead = str(tmp_path / "nobody-listening.sock")
+    cli = ServeClient(f"{dead},{live}",
+                      tenant=_tenant_for_index(0, 2),
+                      retries=4, connect_timeout_s=10.0)
+    try:
+        assert cli.endpoint.spec != live  # starts on the dead one
+        assert cli.ping()["pong"] is True
+        assert cli.failovers >= 1
+        assert cli.endpoint.spec == live
+        r = cli.sql("SELECT count(*) AS n FROM t")
+        assert r["status"] == "ok" and r["data"] == [[10]]
+    finally:
+        cli.close()
+
+
+def test_client_all_endpoints_down_raises_typed_transient(tmp_path):
+    """Satellite 3: every endpoint down -> NoHealthyEndpoint naming
+    the endpoints tried, classified transient for outer retry loops."""
+    d1 = str(tmp_path / "d1.sock")
+    d2 = str(tmp_path / "d2.sock")
+    cli = ServeClient(f"{d1},{d2}", retries=0, connect_timeout_s=0.3,
+                      backoff_s=0.01)
+    with pytest.raises(NoHealthyEndpoint) as ei:
+        cli.ping()
+    assert sorted(ei.value.endpoints) == sorted(
+        [f"unix:{d1}", f"unix:{d2}"])
+    assert taxonomy.classify(ei.value) == "transient"
+    # single endpoint keeps the PR 14 contract: the raw OSError
+    solo = ServeClient(d1, retries=0, connect_timeout_s=0.3,
+                       backoff_s=0.01)
+    with pytest.raises(OSError) as ei2:
+        solo.ping()
+    assert not isinstance(ei2.value, NoHealthyEndpoint)
+
+
+def test_bind_early_probe_answers_and_sql_sheds_until_ready(tmp_path):
+    """Satellite 3 readiness gating: a bind_early replica answers the
+    probe verb immediately, sheds sql as retryable ``overloaded``
+    while warming, and flips ready only after the warm/AOT work is
+    done."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    class SlowBoot(QueryServer):
+        def _aot_precompile(self):
+            entered.set()
+            assert gate.wait(30.0)
+            super()._aot_precompile()
+
+    sock = str(tmp_path / "warm_gate.sock")
+    srv = SlowBoot(ServeConfig(socket_path=sock, engine="cpu",
+                               journal_path=str(tmp_path / "j.jsonl"),
+                               ledger_path="none", bind_early=True,
+                               replica_id="r-gate"),
+                   session=tiny_session())
+    boot = threading.Thread(target=srv.start, daemon=True)
+    boot.start()
+    try:
+        assert entered.wait(30.0)
+        cli = ServeClient(sock, retries=0, connect_timeout_s=10.0)
+        probe = cli.probe()   # probe answers while still warming
+        assert probe["alive"] is True and probe["ready"] is False
+        assert probe["replica_id"] == "r-gate"
+        resp = cli._roundtrip({"op": "sql", "id": "w1",
+                               "sql": "SELECT count(*) FROM t",
+                               "tenant": "warm"})
+        assert resp["status"] == "overloaded"  # retryable, NOT fatal
+        assert resp["retry_after_s"] > 0
+        before = obs.counters_snapshot()
+        gate.set()
+        boot.join(30.0)
+        assert not boot.is_alive()
+        assert cli.wait_ready(10.0)
+        assert cli.probe()["ready"] is True
+        r = cli.sql("SELECT count(*) AS n FROM t")
+        assert r["data"] == [[10]]
+        assert obs.counter_delta(before).get(
+            "serve.warming_rejects", 0) == 0  # none after readiness
+        cli.close()
+    finally:
+        gate.set()
+        if not srv.draining:
+            srv.drain(reason="test")
+
+
+# -- EWMA retry hint (satellite 1) -------------------------------------------
+
+def test_admission_queue_ewma_hint_grows_and_decays():
+    q = AdmissionQueue(depth=2, est_wait_s=0.25, ewma_alpha=0.5)
+    assert q.est_wait_s == pytest.approx(0.25)  # seed before data
+    for _ in range(4):
+        q.observe(2.0)  # slow queries: the hint must grow
+    grown = q.est_wait_s
+    assert grown > 1.0
+    for _ in range(8):
+        q.observe(0.01)  # fast again: the hint must decay back
+    assert q.est_wait_s < 0.1 < grown
+    snap = q.snapshot()
+    assert snap["observed"] == 12
+    assert snap["est_wait_s"] == pytest.approx(q.est_wait_s,
+                                               abs=1e-5)
+
+
+def test_admission_queue_shed_hint_tracks_ewma():
+    q = AdmissionQueue(depth=1, est_wait_s=0.25, ewma_alpha=1.0)
+    q.observe(3.0)  # alpha=1: est jumps straight to the observation
+    q.admit()
+    with pytest.raises(Overloaded) as ei:
+        q.admit()
+    assert ei.value.retry_after_s == pytest.approx(3.0)
+    q.release()
+
+
+# -- memplan admission budget (tentpole seam) --------------------------------
+
+def test_memplan_admission_budget_clamps_and_env(monkeypatch):
+    from ndstpu.engine import memplan
+
+    doc = memplan.admission_budget(budget_bytes=8 << 30,
+                                   bytes_per_query=64 << 20)
+    assert doc["depth"] == (8 << 30) // 2 // (64 << 20)
+    assert doc["budget_source"] == "caller"
+    # starved budget clamps to the floor, never zero
+    doc = memplan.admission_budget(budget_bytes=16 << 20,
+                                   bytes_per_query=64 << 20)
+    assert doc["depth"] == memplan.ADMISSION_MIN_DEPTH
+    # huge budget clamps to the ceiling
+    doc = memplan.admission_budget(budget_bytes=1 << 50,
+                                   bytes_per_query=1)
+    assert doc["depth"] == memplan.ADMISSION_MAX_DEPTH
+    # NDSTPU_HBM_BYTES drives the budget (source: env), the serve
+    # knob overrides the per-query working set
+    monkeypatch.setenv("NDSTPU_HBM_BYTES", str(1 << 30))
+    monkeypatch.setenv("NDSTPU_SERVE_QUERY_BYTES", str(128 << 20))
+    doc = memplan.admission_budget()
+    assert doc["budget_source"] == "env"
+    assert doc["bytes_per_query"] == 128 << 20
+    assert doc["depth"] == (1 << 30) // 2 // (128 << 20)
+
+
+def test_server_auto_queue_depth_from_memplan(serve_env, monkeypatch):
+    monkeypatch.setenv("NDSTPU_HBM_BYTES", str(192 << 20))
+    srv, cli = serve_env(queue_depth=None)
+    h = cli.health()
+    assert h["admission_model"]["budget_source"] == "env"
+    assert h["admission_model"]["depth"] == 1
+    assert h["queue_depth"] == 1
+
+
+# -- fleet supervisor units (injectable probe/launcher) ----------------------
+
+class _FakeProc:
+    def __init__(self, pid):
+        self.pid = pid
+        self.rc = None
+        self.returncode = None
+
+    def poll(self):
+        self.returncode = self.rc
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        self.returncode = self.rc
+        return self.rc
+
+
+def _fleet_cfg(tmp_path, **kw):
+    from ndstpu.serve.fleet import FleetConfig
+    defaults = dict(input_prefix=str(tmp_path / "wh"),
+                    replicas=2, run_dir=str(tmp_path / "fleet"),
+                    probe_interval_s=30.0, probe_fail_threshold=3,
+                    restart_backoff_s=0.0, restart_backoff_max_s=0.0)
+    defaults.update(kw)
+    return FleetConfig(**defaults)
+
+
+def test_fleet_adopts_live_replicas_instead_of_double_starting(
+        tmp_path):
+    from ndstpu.serve.fleet import FleetSupervisor
+    launched = []
+
+    def launcher(rep):
+        p = _FakeProc(pid=1000 + len(launched))
+        launched.append(rep.replica_id)
+        return p
+
+    def probe(rep):
+        if rep.replica_id == "r0":  # r0 is already running out there
+            return {"alive": True, "ready": True, "pid": 4242}
+        raise ConnectionRefusedError("r1 not running")
+
+    sup = FleetSupervisor(_fleet_cfg(tmp_path, probe_fail_threshold=99),
+                          probe_fn=probe, launcher=launcher)
+    sup.start()
+    try:
+        r0, r1 = sup.replicas
+        assert r0.adopted and r0.pid == 4242 and r0.ready
+        assert "r0" not in launched, "adopted replica was double-started"
+        assert launched == ["r1"]
+        doc = sup.health_doc()
+        assert doc["artifact"] == "ndstpu-fleet-health-v1"
+        assert doc["replicas"][0]["adopted"] is True
+        assert os.path.exists(sup.health_path)
+    finally:
+        sup._stopped.set()
+
+
+def test_fleet_restarts_dead_replica_and_fences_stale_lock(tmp_path):
+    from ndstpu.io import commit as commit_mod
+    from ndstpu.serve.fleet import FleetSupervisor
+    wh = tmp_path / "wh" / "store_sales"
+    wh.mkdir(parents=True)
+    launched = []
+
+    def launcher(rep):
+        p = _FakeProc(pid=1000 + len(launched))
+        launched.append(p)
+        return p
+
+    sup = FleetSupervisor(_fleet_cfg(tmp_path, replicas=1),
+                          probe_fn=lambda rep: {"alive": True,
+                                                "ready": True,
+                                                "pid": None},
+                          launcher=launcher)
+    rep = sup.replicas[0]
+    sup._start_replica(rep)
+    assert len(launched) == 1 and rep.pid == 1000
+    # the replica dies holding a CAS commit lease; a live stranger's
+    # lease must survive the fence
+    stale = wh / commit_mod.LOCK_BASENAME
+    stale.write_text(json.dumps({"pid": rep.pid, "ts": 0}))
+    live_dir = tmp_path / "wh" / "other"
+    live_dir.mkdir()
+    (live_dir / commit_mod.LOCK_BASENAME).write_text(
+        json.dumps({"pid": os.getpid(), "ts": 0}))
+    launched[0].rc = 9
+    sup._check_one(rep)
+    assert rep.restarts == 1
+    assert len(launched) == 2, "death did not relaunch the replica"
+    assert rep.pid == launched[1].pid, "pid not tracking the relaunch"
+    assert not stale.exists(), "stale commit lease was not fenced"
+    assert (live_dir / commit_mod.LOCK_BASENAME).exists(), \
+        "fence broke a LIVE pid's lease"
+
+
+def test_fleet_probe_failures_restart_only_at_threshold(tmp_path):
+    from ndstpu.serve.fleet import FleetSupervisor
+    launched = []
+
+    def launcher(rep):
+        p = _FakeProc(pid=2000 + len(launched))
+        launched.append(p)
+        return p
+
+    def probe(rep):
+        raise ConnectionRefusedError("injected probe failure")
+
+    sup = FleetSupervisor(
+        _fleet_cfg(tmp_path, replicas=1, probe_fail_threshold=3,
+                   boot_grace_s=0.5),
+        probe_fn=probe, launcher=launcher)
+    rep = sup.replicas[0]
+    sup._start_replica(rep)
+    sup._check_one(rep)
+    assert rep.consecutive_failures == 0, \
+        "a probe failure during the boot grace window counted"
+    rep.launched_at -= 1.0  # age the incarnation past the grace
+    sup._check_one(rep)
+    sup._check_one(rep)
+    assert rep.restarts == 0, "restarted below the probe threshold"
+    sup._check_one(rep)  # third consecutive failure crosses it
+    assert rep.restarts == 1 and len(launched) == 2
+
+
+def test_fleet_kill_switch_degenerates_to_one_replica(tmp_path,
+                                                      monkeypatch):
+    from ndstpu.serve import fleet as fleet_mod
+    monkeypatch.setenv(fleet_mod.FLEET_ENV, "0")
+    sup = fleet_mod.FleetSupervisor(
+        _fleet_cfg(tmp_path, replicas=3),
+        probe_fn=lambda rep: {"alive": True, "ready": True},
+        launcher=lambda rep: _FakeProc(pid=1))
+    assert len(sup.replicas) == 1
+    assert "," not in sup.endpoints_spec()
+
+
+def test_fleet_default_endpoints_stable_and_short(tmp_path):
+    from ndstpu.serve.fleet import default_endpoints
+    a = default_endpoints(str(tmp_path / "fleet"), 3)
+    b = default_endpoints(str(tmp_path / "fleet"), 3)
+    assert a == b, "re-adoption needs stable endpoint derivation"
+    assert len(set(a)) == 3
+    assert all(len(p) < 100 for p in a), "AF_UNIX ~108-byte path cap"
+    assert default_endpoints(str(tmp_path / "other"), 3) != a
